@@ -1,0 +1,62 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+A ground-up redesign of the reference runtime (Ray ≈2.6, see SURVEY.md) for
+TPU clusters: tasks/actors/objects with ownership-based futures and gang
+placement groups on the control plane; JAX/XLA/pjit/Pallas as the tensor
+plane, with ICI collectives compiled into SPMD programs instead of an
+NCCL-style library.
+
+Public core API mirrors the reference's (``ray.*``):
+    init, shutdown, remote, get, put, wait, kill, cancel, get_actor,
+    placement_group, nodes, cluster_resources, ...
+Library layers live in submodules: ``ray_tpu.train``, ``ray_tpu.tune``,
+``ray_tpu.data``, ``ray_tpu.serve``, ``ray_tpu.rllib``, ``ray_tpu.collective``,
+``ray_tpu.parallel``, ``ray_tpu.models``, ``ray_tpu.ops``.
+"""
+
+from ray_tpu._version import __version__
+from ray_tpu.core.api import (
+    ActorClass,
+    ActorHandle,
+    NodeAffinitySchedulingStrategy,
+    PlacementGroup,
+    PlacementGroupSchedulingStrategy,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    placement_group,
+    placement_group_table,
+    put,
+    remote,
+    remove_placement_group,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTaskError,
+    TaskCancelledError,
+    WorkerCrashedError,
+)
+from ray_tpu.core.object_ref import ObjectRef
+
+__all__ = [
+    "__version__", "init", "shutdown", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "is_initialized", "ObjectRef",
+    "ActorClass", "ActorHandle", "PlacementGroup", "placement_group",
+    "remove_placement_group", "placement_group_table",
+    "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
+    "nodes", "cluster_resources", "available_resources",
+    "RayTaskError", "ActorDiedError", "ActorUnavailableError",
+    "GetTimeoutError", "ObjectLostError", "TaskCancelledError",
+    "WorkerCrashedError",
+]

@@ -1,0 +1,49 @@
+"""Virtual multi-node cluster for tests.
+
+Analog of the reference's ``ray.cluster_utils.Cluster``
+(python/ray/cluster_utils.py:99, add_node :165): N logical nodes in one
+process, each with its own resource view, worker pool, and shm object store,
+all hosted by the embedded head. The workhorse for scheduling / placement /
+failover tests without real hosts (SURVEY.md §4.2).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.core import api
+from ray_tpu.core.resources import TpuTopology
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[dict] = None):
+        self._info = None
+        if initialize_head:
+            args = dict(head_node_args or {})
+            self._info = api.init(**args)
+
+    @property
+    def head(self):
+        from ray_tpu.core.api import _head
+
+        return _head
+
+    def add_node(self, *, num_cpus: int = 1, num_tpus: int = 0,
+                 memory: Optional[int] = None,
+                 object_store_memory: Optional[int] = None,
+                 resources: Optional[dict] = None,
+                 labels: Optional[dict] = None,
+                 tpu_topology: Optional[TpuTopology] = None) -> int:
+        """Add a logical node; returns its node index."""
+        return self.head.add_node(
+            num_cpus=num_cpus, num_tpus=num_tpus, memory=memory,
+            object_store_memory=object_store_memory, resources=resources,
+            labels=labels, tpu_topology=tpu_topology)
+
+    def remove_node(self, node_idx: int):
+        """Kill a logical node (workers die, objects on it are lost)."""
+        self.head.remove_node(node_idx)
+
+    def shutdown(self):
+        api.shutdown()

@@ -1,0 +1,1 @@
+"""Core runtime: ids, resources, scheduling, object store, tasks, actors."""

@@ -1,0 +1,107 @@
+"""Runtime configuration knobs, env-overridable.
+
+Analog of the reference's RAY_CONFIG X-macro system
+(src/ray/common/ray_config_def.h — 203 ``RAY_CONFIG(type, name, default)``
+entries, overridable via ``RAY_<name>`` env vars). We keep the same contract:
+every knob has a typed compile-time default and can be overridden with
+``RAY_TPU_<NAME>`` in the environment or via ``init(_system_config=...)``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+@dataclass
+class Config:
+    # --- object store ---
+    # Size of the shared-memory object store arena per node, bytes.
+    object_store_memory: int = 512 * 1024 * 1024
+    # Objects smaller than this are inlined into task replies / in-process
+    # store instead of the shm store (reference: max_direct_call_object_size,
+    # ray_config_def.h).
+    max_inline_object_size: int = 100 * 1024
+    # Chunk size for node-to-node object transfer (reference: 5 MiB,
+    # ray_config_def.h:348).
+    object_transfer_chunk_bytes: int = 5 * 1024 * 1024
+    # Spill threshold: fraction of arena used before spilling kicks in.
+    object_spilling_threshold: float = 0.8
+    spill_dir: str = ""
+
+    # --- scheduling ---
+    # Hybrid scheduling policy: prefer local node until its utilization
+    # exceeds this, then spread (reference: scheduler_spread_threshold).
+    scheduler_spread_threshold: float = 0.5
+    # Top-k fraction of nodes considered for random tie-breaking
+    # (reference: scheduler_top_k_fraction).
+    scheduler_top_k_fraction: float = 0.2
+    # Max tasks in flight pushed to one worker before backpressure.
+    max_tasks_in_flight_per_worker: int = 10
+
+    # --- worker pool ---
+    # Max idle workers kept alive per scheduling class.
+    idle_worker_keep_alive_s: float = 30.0
+    # Hard cap on worker processes per node (we run on few cores).
+    max_workers_per_node: int = 16
+    # Seconds to wait for a worker process to register before failing.
+    worker_register_timeout_s: float = 30.0
+
+    # --- actors ---
+    actor_creation_timeout_s: float = 60.0
+
+    # --- health / fault tolerance ---
+    # Reference: 3s period, 5 failures (ray_config_def.h:791-797).
+    health_check_period_s: float = 3.0
+    health_check_failure_threshold: int = 5
+    task_max_retries_default: int = 3
+
+    # --- logging / events ---
+    log_dir: str = ""
+    task_event_buffer_size: int = 10000
+
+    # --- TPU ---
+    # Override autodetected TPU topology, e.g. "v5p-64".
+    tpu_accelerator_type: str = ""
+
+    def __post_init__(self):
+        for f in fields(self):
+            env = os.environ.get(_ENV_PREFIX + f.name.upper())
+            if env is None:
+                continue
+            if f.type in ("int", int):
+                setattr(self, f.name, int(env))
+            elif f.type in ("float", float):
+                setattr(self, f.name, float(env))
+            elif f.type in ("bool", bool):
+                setattr(self, f.name, env.lower() in ("1", "true", "yes"))
+            else:
+                setattr(self, f.name, env)
+
+    def apply_overrides(self, overrides: dict | str | None):
+        if not overrides:
+            return
+        if isinstance(overrides, str):
+            overrides = json.loads(overrides)
+        for k, v in overrides.items():
+            if not hasattr(self, k):
+                raise ValueError(f"Unknown config key: {k}")
+            setattr(self, k, v)
+
+
+_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _config
+    if _config is None:
+        _config = Config()
+    return _config
+
+
+def reset_config():
+    global _config
+    _config = None

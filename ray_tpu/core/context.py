@@ -1,0 +1,1082 @@
+"""CoreContext — the per-process core-worker runtime.
+
+Analog of the reference's ``CoreWorker`` (src/ray/core_worker/core_worker.h:284
+— Put :560, Get :667, Wait :706, SubmitTask :830, CreateActor :851,
+SubmitActorTask :897) plus its direct task transport
+(transport/direct_task_transport.h:75, direct_actor_task_submitter.h:67).
+Every process — driver and workers alike — runs one CoreContext: a single IO
+thread multiplexing the head connection (GCS+raylet client) and direct
+worker-to-worker connections; an in-process memory store for futures; a
+shared-memory store client for large objects; a submitter that leases workers
+per scheduling class and pushes tasks directly to them; and (in workers) the
+task executor.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import queue as queue_mod
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import protocol as P
+from .config import get_config
+from .exceptions import (ActorDiedError, ActorUnavailableError, GetTimeoutError,
+                         ObjectLostError, RayTaskError, TaskCancelledError,
+                         TaskError, WorkerCrashedError)
+from .function_manager import FunctionManager
+from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from .memory_store import MemoryStore
+from .object_ref import ObjectRef
+from .object_store import ShmObjectStore
+from .ref_counter import ReferenceCounter
+from .serialization import SerializedValue, deserialize, serialize
+from .task_spec import (ARG_REF, ARG_VALUE, SchedulingStrategy, TaskSpec,
+                        TaskType)
+
+_context: Optional["CoreContext"] = None
+_context_lock = threading.Lock()
+
+
+def get_context() -> "CoreContext":
+    if _context is None:
+        raise RuntimeError("ray_tpu not initialized — call ray_tpu.init()")
+    return _context
+
+
+def get_context_if_exists() -> Optional["CoreContext"]:
+    return _context
+
+
+def set_context(ctx: Optional["CoreContext"]):
+    global _context
+    _context = ctx
+
+
+class _LeasedWorker:
+    __slots__ = ("worker_id", "addr", "lease_id", "conn", "inflight",
+                 "idle_since")
+
+    def __init__(self, worker_id, addr, lease_id, conn):
+        self.worker_id = worker_id
+        self.addr = addr
+        self.lease_id = lease_id
+        self.conn = conn
+        self.inflight: Dict[TaskID, TaskSpec] = {}
+        self.idle_since = time.monotonic()
+
+
+class _ClassState:
+    __slots__ = ("queue", "workers", "pending_leases")
+
+    def __init__(self):
+        self.queue: deque = deque()
+        self.workers: List[_LeasedWorker] = []
+        self.pending_leases = 0
+
+
+class _ActorState:
+    __slots__ = ("actor_id", "state", "addr", "conn", "queue", "inflight",
+                 "seqno", "lock", "resolving", "death_cause", "connecting")
+
+    def __init__(self, actor_id):
+        self.connecting = False
+        self.actor_id = actor_id
+        self.state = "UNKNOWN"
+        self.addr = ""
+        self.conn: Optional[P.Connection] = None
+        self.queue: deque = deque()
+        self.inflight: Dict[TaskID, TaskSpec] = {}
+        self.seqno = itertools.count()
+        self.lock = threading.Lock()
+        self.resolving = False
+        self.death_cause = ""
+
+
+class _InflightTask:
+    __slots__ = ("spec", "arg_ids", "retries_left", "contained_holder")
+
+    def __init__(self, spec, arg_ids, retries_left, contained_holder):
+        self.spec = spec
+        self.arg_ids = arg_ids
+        self.retries_left = retries_left
+        self.contained_holder = contained_holder  # keeps ObjectRefs alive
+
+
+class CoreContext:
+    def __init__(self, head_addr: str, session_dir: str, node_idx: int,
+                 worker_id: Optional[str] = None, is_driver: bool = False,
+                 job_id: Optional[JobID] = None):
+        self.head_addr = head_addr
+        self.session_dir = session_dir
+        self.node_idx = node_idx
+        self.is_driver = is_driver
+        self.worker_id = worker_id or WorkerID.from_random().hex()
+        self.job_id = job_id or JobID.from_int(1)
+        self.current_task_id = TaskID.for_driver(self.job_id)
+        self._put_index = itertools.count(1)
+
+        self.memory_store = MemoryStore()
+        self.ref_counter = ReferenceCounter(
+            self.worker_id, self._free_owned_object, self._release_borrow)
+
+        # executor / misc state (must exist before any thread starts)
+        self._exec_queue: "queue_mod.Queue" = queue_mod.Queue()
+        self._actor_instance = None
+        self._actor_spec: Optional[TaskSpec] = None
+        self._cancelled: set = set()
+        self._pinned: set = set()
+        self._contained: Dict[ObjectID, list] = {}
+        self._shutdown = False
+        self._async_loop = None
+        self._actors: Dict[ActorID, _ActorState] = {}
+        self._pub_handlers: Dict[str, List] = {}
+        self._pub_lock = threading.Lock()
+
+        self.io = P.IOLoop(f"io-{self.worker_id[:6]}")
+        # Own listener for direct pushes from peers.
+        self.listen_path = os.path.join(session_dir,
+                                        f"w_{self.worker_id[:12]}.sock")
+        self.listen_addr = f"unix:{self.listen_path}"
+        self._listener = P.listen_unix(self.listen_path)
+        self.io.add_listener(self._listener, self._on_accept)
+
+        # Head connection (GCS + raylet client).
+        sock = P.connect_addr(head_addr)
+        self.head = P.Connection(sock, peer="head")
+        self.head.on_close = self._on_head_close
+        self.io.add_connection(self.head, self._on_head_message)
+        self.io.start()
+
+        reply = self.head.call(P.REGISTER, self.worker_id, os.getpid(),
+                               self.listen_addr, node_idx, timeout=30)
+        store_name = reply[0]
+        self.store = ShmObjectStore(store_name)
+        self._stores_by_node: Dict[int, ShmObjectStore] = {node_idx: self.store}
+
+        self.fn_manager = FunctionManager(self.kv_put, self.kv_get)
+
+        # submitter
+        self._classes: Dict[tuple, _ClassState] = {}
+        self._inflight: Dict[TaskID, _InflightTask] = {}
+        self._return_to_task: Dict[ObjectID, TaskID] = {}
+        self._sub_lock = threading.RLock()
+        self._submit_event = threading.Event()
+        self._submitter = threading.Thread(target=self._submitter_loop,
+                                           daemon=True, name="submitter")
+        self._submitter.start()
+
+
+    # ================================================== connections / IO
+
+    def _on_accept(self, sock, addr):
+        conn = P.Connection(sock, peer="peer-in")
+        self.io.add_connection(conn, self._on_peer_message)
+
+    def _on_peer_message(self, conn: P.Connection, msg):
+        mt = msg[0]
+        if mt == P.PUSH_TASK:
+            self._exec_queue.put((msg[2], conn))
+        elif mt == P.PUSH_CANCEL:
+            self._cancelled.add(TaskID(msg[2]))
+        elif mt == P.TASK_REPLY:
+            self._handle_task_reply(conn, *msg[2:])
+
+    def _on_head_message(self, conn: P.Connection, msg):
+        mt = msg[0]
+        if mt == P.PUSH_TASK:
+            # actor creation task pushed by the head scheduler
+            self._exec_queue.put((msg[2], conn))
+        elif mt == P.PUBLISH:
+            channel, payload = msg[2], msg[3]
+            with self._pub_lock:
+                handlers = list(self._pub_handlers.get(channel, ()))
+            from .serialization import loads
+
+            data = loads(payload)
+            for h in handlers:
+                try:
+                    h(data)
+                except Exception:
+                    traceback.print_exc()
+        elif mt == P.BORROW_ADD:
+            self.ref_counter.add_borrower(ObjectID(msg[2]), msg[3])
+        elif mt == P.BORROW_REMOVE:
+            self.ref_counter.remove_borrower(ObjectID(msg[2]), msg[3])
+        elif mt == P.KILL_ACTOR:
+            os._exit(0)
+
+    def _on_head_close(self, conn):
+        if not self._shutdown and not self.is_driver:
+            # head gone — worker exits (reference: raylet death kills workers)
+            os._exit(1)
+
+    def subscribe(self, channel: str, handler):
+        with self._pub_lock:
+            first = channel not in self._pub_handlers
+            self._pub_handlers.setdefault(channel, []).append(handler)
+        if first:
+            self.head.call(P.SUBSCRIBE, channel, timeout=10)
+
+    def publish(self, channel: str, data):
+        from .serialization import dumps
+
+        self.head.send(P.PUBLISH, channel, dumps(data))
+
+    # ================================================== KV
+
+    def kv_put(self, ns, key, value, overwrite=True) -> bool:
+        return self.head.call(P.KV_PUT, ns, key, value, overwrite,
+                              timeout=30)[0]
+
+    def kv_get(self, ns, key):
+        return self.head.call(P.KV_GET, ns, key, timeout=30)[0]
+
+    def kv_del(self, ns, key) -> bool:
+        return self.head.call(P.KV_DEL, ns, key, timeout=30)[0]
+
+    def kv_keys(self, ns, prefix="") -> list:
+        return self.head.call(P.KV_KEYS, ns, prefix, timeout=30)[0]
+
+    # ================================================== put / get / wait
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.for_put(self.current_task_id, next(self._put_index))
+        sv = serialize(value)
+        self.ref_counter.add_owned(oid)
+        if sv.contained_refs:
+            # Inner refs stay alive at least as long as the outer object is
+            # tracked by this owner (simplified containment pinning; the
+            # reference tracks contained ids in the outer's metadata).
+            self._contained[oid] = list(sv.contained_refs)
+        self.store.put_serialized(oid, sv.frames)
+        self.head.send(P.OBJECT_SEALED, oid.binary(), self.node_idx,
+                       sv.total_bytes, self.worker_id)
+        self.memory_store.put_plasma_location(oid, self.node_idx)
+        return ObjectRef(oid, self.worker_id)
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None
+            ) -> List[Any]:
+        oids = [r.id for r in refs]
+        self._ensure_resolution(refs)
+        ready = self.memory_store.wait_ready(oids, len(oids), timeout)
+        if len(ready) < len(set(oids)):
+            raise GetTimeoutError(
+                f"get() timed out after {timeout}s; "
+                f"{len(set(oids)) - len(ready)} objects pending")
+        return [self._resolve_value(oid) for oid in oids]
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int,
+             timeout: Optional[float], fetch_local: bool = True
+             ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        self._ensure_resolution(refs)
+        ready_ids = set(self.memory_store.wait_ready(
+            [r.id for r in refs], num_returns, timeout))
+        ready, rest = [], []
+        for r in refs:
+            if r.id in ready_ids and len(ready) < num_returns:
+                ready.append(r)
+            else:
+                rest.append(r)
+        return ready, rest
+
+    def as_future(self, ref: ObjectRef):
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._ensure_resolution([ref])
+
+        def _cb():
+            try:
+                fut.set_result(self._resolve_value(ref.id))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        self.memory_store.add_ready_callback(ref.id, _cb)
+        return fut
+
+    def _resolve_value(self, oid: ObjectID) -> Any:
+        e = self.memory_store.peek(oid)
+        if e is None:
+            raise ObjectLostError(oid.hex())
+        if e.in_plasma and e.value is None:
+            value = self._fetch_from_plasma(oid, e.node_idx)
+            e.value = value
+        if e.is_error:
+            err = e.value
+            if isinstance(err, TaskError):
+                raise RayTaskError(err)
+            raise err
+        return e.value
+
+    def _fetch_from_plasma(self, oid: ObjectID, node_idx: int) -> Any:
+        if node_idx != self.node_idx or not self.store.contains(oid):
+            # Pull to the local node's store (reference: PullManager).
+            self.head.call(P.OBJECT_TRANSFER, oid.binary(), self.node_idx,
+                           timeout=120)
+        frames = self.store.get_frames(oid)
+        if frames is None:
+            raise ObjectLostError(f"{oid.hex()} not in local store")
+        self._pinned.add(oid)
+        return deserialize(frames)
+
+    def _ensure_resolution(self, refs: Sequence[ObjectRef]):
+        """For refs we don't own and aren't already expecting, fetch in the
+        background so wait_ready can complete."""
+        for r in refs:
+            oid = r.id
+            if self.memory_store.contains(oid):
+                continue
+            with self._sub_lock:
+                expected = oid in self._return_to_task
+            if expected:
+                continue
+            t = threading.Thread(target=self._background_fetch, args=(oid,),
+                                 daemon=True)
+            t.start()
+
+    def _background_fetch(self, oid: ObjectID):
+        try:
+            node_idx, size, spilled = self.head.call(
+                P.OBJECT_LOCATE, oid.binary(), True, timeout=None)
+            self.memory_store.put_plasma_location(oid, node_idx)
+        except Exception:
+            pass
+
+    # ================================================== GC callbacks
+
+    def _free_owned_object(self, oid: ObjectID):
+        self._contained.pop(oid, None)
+        self.memory_store.evict(oid)
+        if oid in self._pinned:
+            self._pinned.discard(oid)
+            try:
+                self.store.release(oid)
+            except Exception:
+                pass
+        try:
+            self.head.send(P.OBJECT_FREE, [oid.binary()])
+        except P.ConnectionLost:
+            pass
+
+    def _release_borrow(self, oid: ObjectID, owner: str):
+        self.memory_store.evict(oid)
+        if oid in self._pinned:
+            self._pinned.discard(oid)
+            try:
+                self.store.release(oid)
+            except Exception:
+                pass
+        try:
+            self.head.send(P.BORROW_REMOVE, oid.binary(), owner,
+                           self.worker_id)
+        except P.ConnectionLost:
+            pass
+
+    def notify_deserialized_ref(self, ref: ObjectRef):
+        if ref.owner and ref.owner != self.worker_id:
+            try:
+                self.head.send(P.BORROW_ADD, ref.id.binary(), ref.owner,
+                               self.worker_id)
+            except P.ConnectionLost:
+                pass
+
+    # ================================================== task submission
+
+    def submit_task(self, fn, args, kwargs, *, num_returns=1, resources=None,
+                    strategy=None, max_retries=None, retry_exceptions=False,
+                    name="") -> List[ObjectRef]:
+        cfg = get_config()
+        fn_id = self.fn_manager.export(fn)
+        task_id = TaskID.for_normal_task(self.job_id)
+        spec = TaskSpec(
+            task_id=task_id, job_id=self.job_id, task_type=TaskType.NORMAL,
+            name=name or getattr(fn, "__name__", "task"),
+            function_id=fn_id,
+            num_returns=num_returns,
+            resources=resources if resources is not None else {"CPU": 1},
+            strategy=strategy or SchedulingStrategy(),
+            max_retries=(cfg.task_max_retries_default
+                         if max_retries is None else max_retries),
+            retry_exceptions=retry_exceptions,
+            owner=self.worker_id,
+        )
+        arg_ids, holder = self._encode_args(spec, args, kwargs)
+        return self._enqueue_spec(spec, arg_ids, holder)
+
+    def _encode_args(self, spec: TaskSpec, args, kwargs):
+        encoded = []
+        arg_ids: List[ObjectID] = []
+        holder: list = []
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, ObjectRef):
+                self._promote_if_needed(a)
+                encoded.append((ARG_REF, a.id.binary(), a.owner or
+                               self.worker_id))
+                arg_ids.append(a.id)
+                holder.append(a)
+                self.ref_counter.add_task_arg(a.id)
+            else:
+                sv = serialize(a)
+                for r in sv.contained_refs:
+                    self._promote_if_needed(r)
+                    arg_ids.append(r.id)
+                    holder.append(r)
+                    self.ref_counter.add_task_arg(r.id)
+                encoded.append((ARG_VALUE, sv.frames))
+        spec.args = encoded
+        spec.kwarg_names = list(kwargs.keys())
+        return arg_ids, holder
+
+    def _promote_if_needed(self, ref: ObjectRef):
+        """Ensure a ref being lent out is materialized in the shm store so
+        borrowers can fetch it (reference: inline-object promotion)."""
+        e = self.memory_store.peek(ref.id)
+        if e is None or e.in_plasma or e.is_error:
+            return
+        if (ref.owner or self.worker_id) != self.worker_id:
+            return
+        sv = serialize(e.value)
+        try:
+            self.store.put_serialized(ref.id, sv.frames)
+        except Exception:
+            return
+        self.head.send(P.OBJECT_SEALED, ref.id.binary(), self.node_idx,
+                       sv.total_bytes, self.worker_id)
+        e.in_plasma = True
+        e.node_idx = self.node_idx
+
+    def _enqueue_spec(self, spec: TaskSpec, arg_ids, holder) -> List[ObjectRef]:
+        refs = [ObjectRef(oid, self.worker_id, _register=False)
+                for oid in spec.return_ids()]
+        for r in refs:
+            self.ref_counter.add_owned(r.id)
+            self.ref_counter.add_local_ref(r)
+            r._registered = True
+        inflight = _InflightTask(spec, arg_ids, spec.max_retries, holder)
+        cls = spec.scheduling_class()
+        with self._sub_lock:
+            self._inflight[spec.task_id] = inflight
+            for oid in spec.return_ids():
+                self._return_to_task[oid] = spec.task_id
+            st = self._classes.setdefault(cls, _ClassState())
+            st.queue.append(spec)
+        self._submit_event.set()
+        return refs
+
+    def _submitter_loop(self):
+        while not self._shutdown:
+            self._submit_event.wait(0.2)
+            self._submit_event.clear()
+            try:
+                with self._sub_lock:
+                    classes = list(self._classes.items())
+                for cls, st in classes:
+                    self._drain_class(cls, st)
+                self._reap_idle_leases()
+            except Exception:
+                traceback.print_exc()
+
+    def _drain_class(self, cls, st: _ClassState):
+        cfg = get_config()
+        cap = cfg.max_tasks_in_flight_per_worker
+        while True:
+            with self._sub_lock:
+                if not st.queue:
+                    break
+                worker = None
+                for w in st.workers:
+                    if len(w.inflight) < cap:
+                        worker = w
+                        break
+                if worker is None:
+                    demand = len(st.queue)
+                    capacity = len(st.workers) * cap
+                    wanted = min(
+                        (demand - capacity + cap - 1) // cap,
+                        cfg.max_workers_per_node)
+                    need = wanted - st.pending_leases
+                    for _ in range(max(0, need)):
+                        st.pending_leases += 1
+                        threading.Thread(
+                            target=self._request_lease, args=(cls, st),
+                            daemon=True).start()
+                    break
+                spec = st.queue.popleft()
+                if spec.task_id in self._cancelled:
+                    self._finish_cancelled(spec)
+                    continue
+                worker.inflight[spec.task_id] = spec
+                worker.idle_since = time.monotonic()
+            try:
+                worker.conn.send(P.PUSH_TASK, spec, 0)
+            except P.ConnectionLost:
+                self._on_lease_worker_lost(cls, st, worker)
+
+    def _request_lease(self, cls, st: _ClassState):
+        from .serialization import dumps
+
+        sample: Optional[TaskSpec] = None
+        with self._sub_lock:
+            if st.queue:
+                sample = st.queue[0]
+        if sample is None:
+            with self._sub_lock:
+                st.pending_leases -= 1
+            return
+        try:
+            ok, worker_id, addr, lease_id, err = self.head.call(
+                P.LEASE_REQUEST, cls, sample.resources, self.job_id.hex(),
+                dumps(sample.strategy), timeout=None)
+        except Exception as e:  # noqa: BLE001
+            with self._sub_lock:
+                st.pending_leases -= 1
+            self._fail_queued(st, e)
+            return
+        try:
+            sock = P.connect_addr(addr)
+        except OSError as e:
+            with self._sub_lock:
+                st.pending_leases -= 1
+            self.head.send(P.RETURN_WORKER, lease_id, worker_id, True)
+            self._submit_event.set()
+            return
+        conn = P.Connection(sock, peer=f"lease:{worker_id[:8]}")
+        lw = _LeasedWorker(worker_id, addr, lease_id, conn)
+        conn.on_close = lambda c, cls=cls, st=st, lw=lw: \
+            self._on_lease_worker_lost(cls, st, lw)
+        self.io.add_connection(conn, self._on_peer_message)
+        with self._sub_lock:
+            st.pending_leases -= 1
+            st.workers.append(lw)
+        self._submit_event.set()
+
+    def _fail_queued(self, st: _ClassState, err: Exception):
+        with self._sub_lock:
+            specs = list(st.queue)
+            st.queue.clear()
+        for spec in specs:
+            self._complete_task_error(spec, WorkerCrashedError(str(err)))
+
+    def _reap_idle_leases(self):
+        now = time.monotonic()
+        with self._sub_lock:
+            for cls, st in self._classes.items():
+                keep = []
+                for w in st.workers:
+                    if not w.inflight and not st.queue and \
+                            now - w.idle_since > 2.0:
+                        try:
+                            self.head.send(P.RETURN_WORKER, w.lease_id,
+                                           w.worker_id)
+                        except P.ConnectionLost:
+                            pass
+                        w.conn.on_close = None
+                        w.conn.close()
+                    else:
+                        keep.append(w)
+                st.workers = keep
+
+    def _on_lease_worker_lost(self, cls, st: _ClassState, lw: _LeasedWorker):
+        with self._sub_lock:
+            if lw in st.workers:
+                st.workers.remove(lw)
+            lost = list(lw.inflight.values())
+            lw.inflight.clear()
+        for spec in lost:
+            self._maybe_retry(spec, WorkerCrashedError(
+                f"worker {lw.worker_id[:8]} died"), count_retry=True)
+        self._submit_event.set()
+
+    def _maybe_retry(self, spec: TaskSpec, err: Exception, count_retry: bool):
+        with self._sub_lock:
+            inf = self._inflight.get(spec.task_id)
+            if inf is None:
+                return
+            if count_retry and inf.retries_left > 0:
+                inf.retries_left -= 1
+                st = self._classes.setdefault(spec.scheduling_class(),
+                                              _ClassState())
+                st.queue.append(spec)
+                retry = True
+            else:
+                retry = False
+        if retry:
+            self._submit_event.set()
+        else:
+            self._complete_task_error(spec, err)
+
+    def _complete_task_error(self, spec: TaskSpec, err: Exception):
+        for oid in spec.return_ids():
+            self.memory_store.put_value(oid, err, is_error=True)
+        self._finalize_task(spec)
+
+    def _finalize_task(self, spec: TaskSpec):
+        with self._sub_lock:
+            inf = self._inflight.pop(spec.task_id, None)
+            for oid in spec.return_ids():
+                self._return_to_task.pop(oid, None)
+        if inf is not None:
+            for oid in inf.arg_ids:
+                self.ref_counter.remove_task_arg(oid)
+
+    def _finish_cancelled(self, spec: TaskSpec):
+        self._complete_task_error(spec, TaskCancelledError(spec.task_id.hex()))
+
+    def cancel(self, ref: ObjectRef, force: bool = False):
+        with self._sub_lock:
+            task_id = self._return_to_task.get(ref.id)
+            if task_id is None:
+                return
+            self._cancelled.add(task_id)
+            inf = self._inflight.get(task_id)
+            spec = inf.spec if inf else None
+            target = None
+            if spec is not None:
+                st = self._classes.get(spec.scheduling_class())
+                if st:
+                    if spec in st.queue:
+                        st.queue.remove(spec)
+                        self._finish_cancelled(spec)
+                        return
+                    for w in st.workers:
+                        if task_id in w.inflight:
+                            target = w
+                            break
+        if target is not None:
+            try:
+                target.conn.send(P.PUSH_CANCEL, task_id.binary(), force)
+            except P.ConnectionLost:
+                pass
+
+    # -------------------------------------------------- task replies
+
+    def _handle_task_reply(self, conn, task_id_bin, status, result_meta, err):
+        task_id = TaskID(task_id_bin)
+        with self._sub_lock:
+            inf = self._inflight.get(task_id)
+            spec = inf.spec if inf else None
+            # clear from whichever lease worker carried it
+            for st in self._classes.values():
+                for w in st.workers:
+                    if task_id in w.inflight:
+                        del w.inflight[task_id]
+                        w.idle_since = time.monotonic()
+        if spec is None:
+            # actor task reply
+            self._handle_actor_reply(task_id, status, result_meta, err)
+            return
+        if status == "ok":
+            self._store_results(spec, result_meta)
+            self._finalize_task(spec)
+        elif status == "cancelled":
+            self._finish_cancelled(spec)
+        else:
+            if spec.retry_exceptions:
+                self._maybe_retry(spec, err, count_retry=True)
+            else:
+                self._complete_task_error(spec, err)
+        self._submit_event.set()
+
+    def _store_results(self, spec: TaskSpec, result_meta):
+        for oid, entry in zip(spec.return_ids(), result_meta):
+            kind = entry[0]
+            if kind == "v":
+                self.memory_store.put_value(oid, deserialize(entry[1]))
+            else:
+                self.memory_store.put_plasma_location(oid, entry[1])
+
+    # ================================================== actor submission
+
+    def create_actor(self, cls, args, kwargs, *, num_cpus=0, resources=None,
+                     max_restarts=0, max_concurrency=1, name="",
+                     strategy=None, max_task_retries=0) -> "ActorID":
+        from .serialization import dumps
+
+        fn_id = self.fn_manager.export(cls)
+        actor_id = ActorID.of(self.job_id)
+        task_id = TaskID.for_actor_task(actor_id)
+        res = dict(resources or {})
+        if num_cpus:
+            res["CPU"] = num_cpus
+        spec = TaskSpec(
+            task_id=task_id, job_id=self.job_id,
+            task_type=TaskType.ACTOR_CREATION,
+            name=name, function_id=fn_id,
+            resources=res,
+            strategy=strategy or SchedulingStrategy(),
+            owner=self.worker_id, actor_id=actor_id,
+            max_restarts=max_restarts, max_concurrency=max_concurrency,
+            max_retries=max_task_retries,
+        )
+        self._encode_args(spec, args, kwargs)
+        self.head.call(P.CREATE_ACTOR, dumps(spec), timeout=60)
+        st = _ActorState(actor_id)
+        with self._sub_lock:
+            self._actors[actor_id] = st
+        self._watch_actor(actor_id)
+        return actor_id
+
+    def _watch_actor(self, actor_id: ActorID):
+        def on_state(data):
+            state, addr = data
+            self._on_actor_state_change(actor_id, state, addr)
+
+        self.subscribe(f"actor:{actor_id.hex()}", on_state)
+
+    def _actor_state(self, actor_id: ActorID) -> _ActorState:
+        with self._sub_lock:
+            st = self._actors.get(actor_id)
+            if st is None:
+                st = _ActorState(actor_id)
+                self._actors[actor_id] = st
+                self._watch_actor(actor_id)
+            return st
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str, args,
+                          kwargs, *, num_returns=1, max_retries=0
+                          ) -> List[ObjectRef]:
+        st = self._actor_state(actor_id)
+        task_id = TaskID.for_actor_task(actor_id)
+        spec = TaskSpec(
+            task_id=task_id, job_id=self.job_id, task_type=TaskType.ACTOR_TASK,
+            name=method_name, function_id="", method_name=method_name,
+            num_returns=num_returns, owner=self.worker_id,
+            actor_id=actor_id, max_retries=max_retries,
+        )
+        arg_ids, holder = self._encode_args(spec, args, kwargs)
+        refs = [ObjectRef(oid, self.worker_id, _register=False)
+                for oid in spec.return_ids()]
+        for r in refs:
+            self.ref_counter.add_owned(r.id)
+            self.ref_counter.add_local_ref(r)
+            r._registered = True
+        inflight = _InflightTask(spec, arg_ids, max_retries, holder)
+        with self._sub_lock:
+            self._inflight[spec.task_id] = inflight
+            for oid in spec.return_ids():
+                self._return_to_task[oid] = spec.task_id
+        with st.lock:
+            spec.seqno = next(st.seqno)
+            st.queue.append(spec)
+        self._drain_actor(st)
+        return refs
+
+    def _drain_actor(self, st: _ActorState):
+        with st.lock:
+            if st.state == "DEAD":
+                dead = list(st.queue)
+                st.queue.clear()
+            else:
+                dead = []
+        for spec in dead:
+            self._complete_task_error(
+                spec, ActorDiedError(st.death_cause or "actor died"))
+        if dead:
+            return
+        with st.lock:
+            if st.conn is None:
+                if not st.resolving and st.state != "DEAD":
+                    st.resolving = True
+                    threading.Thread(target=self._resolve_actor, args=(st,),
+                                     daemon=True).start()
+                return
+            to_send = []
+            while st.queue:
+                spec = st.queue.popleft()
+                st.inflight[spec.task_id] = spec
+                to_send.append(spec)
+            conn = st.conn
+        for spec in to_send:
+            try:
+                conn.send(P.PUSH_TASK, spec, spec.seqno)
+            except P.ConnectionLost:
+                pass  # conn.on_close handles re-resolution
+
+    def _resolve_actor(self, st: _ActorState):
+        try:
+            state, addr = self.head.call(P.GET_ACTOR, st.actor_id.binary(),
+                                         timeout=None)
+        except Exception as e:  # noqa: BLE001
+            state, addr = "DEAD", str(e)
+        self._on_actor_state_change(st.actor_id, state, addr, resolved=True)
+
+    def _on_actor_state_change(self, actor_id: ActorID, state: str, addr: str,
+                               resolved: bool = False):
+        st = self._actor_state(actor_id)
+        with st.lock:
+            st.resolving = False
+            if (state == "ALIVE" and st.state == "ALIVE"
+                    and st.conn is not None and st.addr == addr):
+                return  # duplicate notification (pubsub + resolution race)
+            prev_conn = st.conn
+            st.conn = None
+            # In-flight calls are lost only when we had a live connection
+            # that is now invalid, or the actor is gone.
+            if prev_conn is not None or state in ("DEAD", "NOT_FOUND",
+                                                  "RESTARTING"):
+                lost = list(st.inflight.values())
+                st.inflight.clear()
+            else:
+                lost = []
+            if state == "ALIVE":
+                st.state = "ALIVE"
+                st.addr = addr
+            elif state in ("DEAD", "NOT_FOUND"):
+                st.state = "DEAD"
+                st.death_cause = addr
+            else:  # RESTARTING
+                st.state = "RESTARTING"
+        if prev_conn is not None:
+            prev_conn.on_close = None
+            prev_conn.close()
+        # in-flight tasks: retry if allowed, else fail
+        for spec in lost:
+            if st.state in ("ALIVE", "RESTARTING") and spec.max_retries != 0:
+                with st.lock:
+                    st.queue.appendleft(spec)
+            elif st.state == "DEAD":
+                self._complete_task_error(
+                    spec, ActorDiedError(st.death_cause or "actor died"))
+            else:
+                self._complete_task_error(spec, ActorUnavailableError(
+                    f"actor {actor_id.hex()} restarting; in-flight call lost"))
+        if st.state == "ALIVE":
+            with st.lock:
+                if st.conn is not None or st.connecting:
+                    return
+                st.connecting = True
+            try:
+                sock = P.connect_addr(addr)
+            except OSError:
+                with st.lock:
+                    st.connecting = False
+                return
+            conn = P.Connection(sock, peer=f"actor:{actor_id.hex()[:8]}")
+            conn.on_close = lambda c: self._on_actor_conn_close(st)
+            self.io.add_connection(conn, self._on_peer_message)
+            with st.lock:
+                st.conn = conn
+                st.connecting = False
+            self._drain_actor(st)
+        elif st.state == "DEAD":
+            self._drain_actor(st)
+
+    def _on_actor_conn_close(self, st: _ActorState):
+        with st.lock:
+            st.conn = None
+            if st.state != "DEAD" and not st.resolving:
+                st.resolving = True
+                threading.Thread(target=self._resolve_actor, args=(st,),
+                                 daemon=True).start()
+
+    def _handle_actor_reply(self, task_id, status, result_meta, err):
+        spec = None
+        with self._sub_lock:
+            inf = self._inflight.get(task_id)
+            if inf is not None:
+                spec = inf.spec
+        if spec is None:
+            return
+        st = self._actor_state(spec.actor_id)
+        with st.lock:
+            st.inflight.pop(task_id, None)
+        if status == "ok":
+            self._store_results(spec, result_meta)
+            self._finalize_task(spec)
+        elif status == "cancelled":
+            self._finish_cancelled(spec)
+        else:
+            self._complete_task_error(spec, err)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self.head.call(P.KILL_ACTOR, actor_id.binary(), no_restart,
+                       timeout=30)
+
+    def get_named_actor(self, name: str) -> Optional[ActorID]:
+        state, addr = self.head.call(P.GET_ACTOR, name, timeout=30)
+        if state == "NOT_FOUND":
+            return None
+        # name lookup returns only existence; the id comes via kv
+        data = self.kv_get("named_actor", name)
+        if data is None:
+            return None
+        return ActorID(data)
+
+    # ================================================== executor (workers)
+
+    def run_executor(self):
+        """Worker main loop: execute pushed tasks until shutdown."""
+        while not self._shutdown:
+            try:
+                item = self._exec_queue.get(timeout=1.0)
+            except queue_mod.Empty:
+                continue
+            if item is None:
+                break
+            spec, conn = item
+            try:
+                self._execute(spec, conn)
+            except P.ConnectionLost:
+                pass
+            except Exception:
+                traceback.print_exc()
+
+    def _decode_args(self, spec: TaskSpec):
+        vals = []
+        for entry in spec.args:
+            if entry[0] == ARG_VALUE:
+                v = deserialize(entry[1])
+                vals.append(v)
+            else:
+                ref = ObjectRef(ObjectID(entry[1]), entry[2])
+                self.notify_deserialized_ref(ref)
+                vals.append(self.get([ref])[0])
+        nk = len(spec.kwarg_names)
+        if nk:
+            pos, kw_vals = vals[:-nk], vals[-nk:]
+            kwargs = dict(zip(spec.kwarg_names, kw_vals))
+        else:
+            pos, kwargs = vals, {}
+        return pos, kwargs
+
+    def _execute(self, spec: TaskSpec, conn: P.Connection):
+        if spec.task_id in self._cancelled:
+            conn.send(P.TASK_REPLY, spec.task_id.binary(), "cancelled", None,
+                      None)
+            return
+        self.current_task_id = spec.task_id
+        try:
+            if spec.task_type == TaskType.ACTOR_CREATION:
+                cls = self.fn_manager.fetch(spec.function_id)
+                args, kwargs = self._decode_args(spec)
+                self._actor_instance = cls(*args, **kwargs)
+                self._actor_spec = spec
+                if spec.name:
+                    self.kv_put("named_actor", spec.name,
+                                spec.actor_id.binary(), True)
+                conn.send(P.TASK_REPLY, spec.task_id.binary(), "ok", [], None)
+                return
+            if spec.task_type == TaskType.ACTOR_TASK:
+                if self._actor_instance is None:
+                    raise RuntimeError("actor not initialized")
+                if spec.method_name == "__ray_terminate__":
+                    conn.send(P.TASK_REPLY, spec.task_id.binary(), "ok",
+                              [("v", serialize(None).frames)], None)
+                    self._graceful_exit()
+                    return
+                fn = getattr(self._actor_instance, spec.method_name)
+                args, kwargs = self._decode_args(spec)
+                result = self._call(fn, args, kwargs)
+            else:
+                fn = self.fn_manager.fetch(spec.function_id)
+                args, kwargs = self._decode_args(spec)
+                result = self._call(fn, args, kwargs)
+        except Exception as e:  # noqa: BLE001
+            te = TaskError(repr(e), traceback.format_exc(), e)
+            try:
+                conn.send(P.TASK_REPLY, spec.task_id.binary(), "error", None,
+                          te)
+            except P.ConnectionLost:
+                pass
+            if spec.task_type == TaskType.ACTOR_CREATION:
+                try:
+                    self.head.send(P.ACTOR_DEAD, spec.actor_id.binary(),
+                                   repr(e))
+                finally:
+                    os._exit(1)
+            return
+        try:
+            result_meta = self._encode_results(spec, result)
+        except Exception as e:  # noqa: BLE001 — e.g. unserializable return
+            te = TaskError(repr(e), traceback.format_exc(), None)
+            conn.send(P.TASK_REPLY, spec.task_id.binary(), "error", None, te)
+            return
+        conn.send(P.TASK_REPLY, spec.task_id.binary(), "ok", result_meta, None)
+
+    def _call(self, fn, args, kwargs):
+        import inspect
+
+        result = fn(*args, **kwargs)
+        if inspect.iscoroutine(result):
+            result = self._run_async(result)
+        return result
+
+    def _run_async(self, coro):
+        import asyncio
+
+        if self._async_loop is None:
+            self._async_loop = asyncio.new_event_loop()
+            t = threading.Thread(target=self._async_loop.run_forever,
+                                 daemon=True, name="async-actor")
+            t.start()
+        fut = asyncio.run_coroutine_threadsafe(coro, self._async_loop)
+        return fut.result()
+
+    def _encode_results(self, spec: TaskSpec, result):
+        cfg = get_config()
+        if spec.num_returns == 1:
+            results = [result]
+        else:
+            results = list(result)
+            if len(results) != spec.num_returns:
+                raise ValueError(
+                    f"task declared num_returns={spec.num_returns} but "
+                    f"returned {len(results)} values")
+        meta = []
+        for oid, value in zip(spec.return_ids(), results):
+            sv = serialize(value)
+            if sv.total_bytes < cfg.max_inline_object_size and \
+                    not sv.contained_refs:
+                meta.append(("v", sv.frames))
+            else:
+                self.store.put_serialized(oid, sv.frames)
+                self.head.send(P.OBJECT_SEALED, oid.binary(), self.node_idx,
+                               sv.total_bytes, spec.owner)
+                meta.append(("p", self.node_idx))
+        return meta
+
+    def _graceful_exit(self):
+        self._shutdown = True
+        try:
+            self.head.send(P.WORKER_EXIT)
+        except P.ConnectionLost:
+            pass
+        os._exit(0)
+
+    # ================================================== lifecycle
+
+    def node_info(self) -> list:
+        return self.head.call(P.NODE_INFO, timeout=30)[0]
+
+    def shutdown(self):
+        self._shutdown = True
+        self._submit_event.set()
+        with self._sub_lock:
+            for st in self._classes.values():
+                for w in st.workers:
+                    try:
+                        self.head.send(P.RETURN_WORKER, w.lease_id,
+                                       w.worker_id)
+                    except P.ConnectionLost:
+                        pass
+                    w.conn.on_close = None
+                    w.conn.close()
+        try:
+            self.head.close()
+        except Exception:
+            pass
+        self.io.stop()
+        try:
+            self._listener.close()
+            os.unlink(self.listen_path)
+        except OSError:
+            pass
+        try:
+            self.store.close()
+        except Exception:
+            pass

@@ -1,0 +1,52 @@
+"""Function/class export and caching.
+
+Analog of python/ray/_private/function_manager.py in the reference: remote
+functions and actor classes are cloudpickled once, exported to the head KV
+under a content-hash key, and lazily fetched + cached by executing workers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Callable, Dict
+
+
+class FunctionManager:
+    NS = "fn"
+
+    def __init__(self, kv_put: Callable, kv_get: Callable):
+        self._kv_put = kv_put
+        self._kv_get = kv_get
+        self._exported: set = set()
+        self._cache: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def export(self, obj: Any) -> str:
+        """Serialize a function/class, export to KV, return its id."""
+        from .serialization import dumps
+
+        data = dumps(obj)
+        fn_id = hashlib.blake2b(data, digest_size=16).hexdigest()
+        with self._lock:
+            if fn_id in self._exported:
+                return fn_id
+        self._kv_put(self.NS, fn_id, data, True)
+        with self._lock:
+            self._exported.add(fn_id)
+            self._cache[fn_id] = obj
+        return fn_id
+
+    def fetch(self, fn_id: str) -> Any:
+        with self._lock:
+            if fn_id in self._cache:
+                return self._cache[fn_id]
+        data = self._kv_get(self.NS, fn_id)
+        if data is None:
+            raise KeyError(f"function {fn_id} not found in KV")
+        from .serialization import loads
+
+        obj = loads(data)
+        with self._lock:
+            self._cache[fn_id] = obj
+        return obj

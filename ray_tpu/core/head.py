@@ -1,0 +1,1030 @@
+"""Head service: GCS-lite control plane + per-node raylet-lite.
+
+Analog of the reference's GCS server (src/ray/gcs/gcs_server/gcs_server.h:78
+— node/actor/job/PG/KV/pubsub/health managers) fused with the raylet's local
+managers (worker_pool.h:156 WorkerPool, local_task_manager.h dispatch,
+local_object_manager.h:41 spilling). On a TPU cluster this is the per-cluster
+control plane over DCN; within one host it runs embedded in the driver
+process. Virtual multi-node (the reference's ray.cluster_utils.Cluster,
+python/ray/cluster_utils.py:99) is first-class: one head can host N logical
+nodes, each with its own resource view, worker pool, and shm object store —
+the workhorse for scheduling/failover tests without real hosts.
+
+Data plane note: tensor traffic never flows through here — within a slice it
+is XLA/ICI inside compiled programs; this plane carries control messages,
+small objects, and checkpoint/object logistics only, mirroring how the
+reference keeps NCCL traffic out of its object store.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import protocol as P
+from .config import get_config
+from .ids import ActorID, ObjectID, PlacementGroupID
+from .object_store import ShmObjectStore
+from .resources import NodeResources, ResourceSet, detect_node_resources
+from .scheduler import ClusterResourceScheduler
+from .serialization import dumps, loads
+from .task_spec import PlacementGroupSpec, TaskSpec
+
+
+@dataclass
+class WorkerInfo:
+    worker_id: str
+    node_idx: int
+    pid: int = 0
+    listen_addr: str = ""
+    conn: Optional[P.Connection] = None
+    proc: Optional[subprocess.Popen] = None
+    state: str = "starting"  # starting | idle | leased | actor | dead
+    sched_class: Optional[tuple] = None
+    lease_id: Optional[str] = None
+    actor_id: Optional[ActorID] = None
+    idle_since: float = 0.0
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    spec: TaskSpec
+    state: str = "PENDING"  # PENDING | ALIVE | RESTARTING | DEAD
+    listen_addr: str = ""
+    worker_id: str = ""
+    restarts_used: int = 0
+    name: str = ""
+    death_cause: str = ""
+    pending_get_replies: List[Tuple[P.Connection, int]] = field(default_factory=list)
+
+
+@dataclass
+class PgInfo:
+    spec: PlacementGroupSpec
+    placement: List[int] = field(default_factory=list)
+    # Per-bundle remaining resources (tasks scheduled into a bundle consume
+    # from here, not from the node's free pool — the reference's
+    # CPU_group_<pgid> shadow-resource mechanism).
+    bundle_available: List[ResourceSet] = field(default_factory=list)
+    state: str = "PENDING"
+
+
+@dataclass
+class NodeState:
+    idx: int
+    resources: NodeResources
+    store: ShmObjectStore
+    store_name: str
+    workers: Dict[str, WorkerInfo] = field(default_factory=dict)
+    idle_by_class: Dict[tuple, List[str]] = field(default_factory=dict)
+    alive: bool = True
+
+
+@dataclass
+class _ObjLoc:
+    node_idx: int = -1
+    size: int = 0
+    owner: str = ""
+    spilled_path: str = ""
+    waiters: List[Tuple[P.Connection, int]] = field(default_factory=list)
+
+
+class Head:
+    def __init__(self, session_dir: str, session_name: str):
+        self.session_dir = session_dir
+        self.session_name = session_name
+        self.addr = f"unix:{session_dir}/head.sock"
+        self.scheduler = ClusterResourceScheduler()
+        self.nodes: Dict[int, NodeState] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[str, ActorID] = {}
+        self.pgs: Dict[PlacementGroupID, PgInfo] = {}
+        self.kv: Dict[str, Dict[str, bytes]] = {}
+        self.subs: Dict[str, Set[P.Connection]] = {}
+        self.objects: Dict[ObjectID, _ObjLoc] = {}
+        self.leases: Dict[str, Tuple[int, ResourceSet, str, Optional[tuple]]] = {}
+        self._lock = threading.RLock()
+        self._pending_pg: List[PlacementGroupID] = []
+        # lease requests waiting for a worker/resources:
+        # (conn, request_id, sched_class, request, strategy_bytes, job)
+        self._pending_leases: List[tuple] = []
+        self.io = P.IOLoop("head-io")
+        self._listener = P.listen_unix(f"{session_dir}/head.sock")
+        self.io.add_listener(self._listener, self._on_accept)
+        self._next_node_idx = 0
+        self._driver_conn: Optional[P.Connection] = None
+        self._shutdown = False
+
+    def start(self):
+        self.io.start()
+
+    # ------------------------------------------------------------- nodes
+
+    def add_node(self, num_cpus=None, num_tpus=None, memory=None,
+                 object_store_memory=None, resources=None, labels=None,
+                 tpu_topology=None) -> int:
+        cfg = get_config()
+        with self._lock:
+            idx = self._next_node_idx
+            self._next_node_idx += 1
+        store_name = f"rtpu_{self.session_name}_{idx}"
+        cap = object_store_memory or cfg.object_store_memory
+        store = ShmObjectStore(store_name, cap, create=True)
+        nr = detect_node_resources(num_cpus=num_cpus, num_tpus=num_tpus,
+                                   memory=memory,
+                                   object_store_memory=cap,
+                                   resources=resources, labels=labels)
+        if tpu_topology is not None:
+            nr.tpu = tpu_topology
+        node = NodeState(idx=idx, resources=nr, store=store,
+                         store_name=store_name)
+        with self._lock:
+            self.nodes[idx] = node
+            self.scheduler.add_node(idx, nr)
+        return idx
+
+    def remove_node(self, idx: int, kill_workers: bool = True):
+        """Simulate node failure (chaos testing / scale-down)."""
+        with self._lock:
+            node = self.nodes.pop(idx, None)
+            self.scheduler.remove_node(idx)
+        if node is None:
+            return
+        node.alive = False
+        if kill_workers:
+            for w in list(node.workers.values()):
+                self._kill_worker_process(w)
+        # objects on this node are lost
+        with self._lock:
+            lost = [oid for oid, loc in self.objects.items()
+                    if loc.node_idx == idx and not loc.spilled_path]
+            for oid in lost:
+                del self.objects[oid]
+        node.store.close()
+        self._publish("node_removed", dumps(idx))
+
+    def _kill_worker_process(self, w: WorkerInfo):
+        w.state = "dead"
+        if w.conn:
+            w.conn.close()
+        if w.proc and w.proc.poll() is None:
+            try:
+                w.proc.kill()
+            except OSError:
+                pass
+
+    # --------------------------------------------------------- accept/IO
+
+    def _on_accept(self, sock, addr):
+        conn = P.Connection(sock, peer="incoming")
+        conn.on_close = self._on_conn_close
+        self.io.add_connection(conn, self._on_message)
+
+    def _on_conn_close(self, conn: P.Connection):
+        with self._lock:
+            dead = None
+            for node in self.nodes.values():
+                for w in node.workers.values():
+                    if w.conn is conn and w.state != "dead":
+                        dead = w
+                        break
+        if dead is not None:
+            self._handle_worker_death(dead)
+        for chan_subs in self.subs.values():
+            chan_subs.discard(conn)
+
+    def _on_message(self, conn: P.Connection, msg):
+        mt, rid = msg[0], msg[1]
+        try:
+            handler = self._HANDLERS[mt]
+        except KeyError:
+            if rid > 0:
+                conn.reply_error(rid, ValueError(f"unknown msg {mt}"))
+            return
+        try:
+            handler(self, conn, rid, *msg[2:])
+        except Exception as e:  # noqa: BLE001
+            if rid > 0:
+                conn.reply_error(rid, e)
+            else:
+                import traceback
+
+                traceback.print_exc()
+
+    # ----------------------------------------------------- worker registry
+
+    def _h_register(self, conn, rid, worker_id, pid, listen_addr, node_idx):
+        with self._lock:
+            node = self.nodes.get(node_idx)
+            if node is None:
+                conn.reply_error(rid, RuntimeError(f"no node {node_idx}"))
+                return
+            w = node.workers.get(worker_id)
+            if w is None:
+                w = WorkerInfo(worker_id=worker_id, node_idx=node_idx)
+                node.workers[worker_id] = w
+            w.pid = pid
+            w.listen_addr = listen_addr
+            w.conn = conn
+            conn.peer = f"worker:{worker_id[:8]}"
+            if w.state == "starting":
+                w.state = "idle"
+                w.idle_since = time.monotonic()
+                if w.sched_class is not None:
+                    node.idle_by_class.setdefault(w.sched_class, []).append(
+                        worker_id)
+        conn.reply(rid, node.store_name, self.session_dir)
+        self._try_fulfill_pending()
+
+    def register_driver(self, conn: Optional[P.Connection] = None):
+        self._driver_conn = conn
+
+    # ----------------------------------------------------------- leases
+
+    def _h_lease_request(self, conn, rid, sched_class, resources, job_id_hex,
+                         strategy_bytes):
+        self._queue_lease(conn, rid, sched_class, resources, job_id_hex,
+                          strategy_bytes)
+        self._try_fulfill_pending()
+
+    def _queue_lease(self, conn, rid, sched_class, resources, job_id_hex,
+                     strategy_bytes):
+        with self._lock:
+            self._pending_leases.append(
+                (conn, rid, tuple(sched_class), ResourceSet(resources),
+                 job_id_hex, strategy_bytes))
+
+    def _try_fulfill_pending(self):
+        """Dispatch loop: try to grant queued leases (reference:
+        ClusterTaskManager::ScheduleAndDispatchTasks)."""
+        from .task_spec import SchedulingStrategy
+
+        while True:
+            granted = False
+            with self._lock:
+                pending = list(self._pending_leases)
+            for item in pending:
+                conn, rid, sched_class, request, job_hex, strategy_bytes = item
+                strategy: SchedulingStrategy = loads(strategy_bytes)
+                grant = self._try_grant(sched_class, request, strategy)
+                if grant is None:
+                    continue
+                with self._lock:
+                    try:
+                        self._pending_leases.remove(item)
+                    except ValueError:
+                        continue
+                granted = True
+                worker, lease_id = grant
+                if worker == "spawning":
+                    continue  # re-queued internally once worker registers
+                conn.reply(rid, True, worker.worker_id, worker.listen_addr,
+                           lease_id, None, msg_type=P.LEASE_REPLY)
+            if not granted:
+                return
+
+    def _try_grant(self, sched_class, request: ResourceSet, strategy
+                   ) -> Optional[Tuple[object, str]]:
+        """Try to allocate resources + a worker. Returns (WorkerInfo, lease)
+        or ("spawning", "") if a worker is being started, or None."""
+        with self._lock:
+            pg_id = strategy.placement_group_id
+            if pg_id is not None:
+                node_idx = self._pg_node_for(pg_id, strategy.bundle_index,
+                                             request)
+                if node_idx is None:
+                    return None
+            else:
+                node_idx = self.scheduler.best_node(request, strategy)
+                if node_idx is None:
+                    return None
+            node = self.nodes[node_idx]
+            # Affinity may target a feasible-but-busy node: stay queued.
+            if pg_id is None and not node.resources.is_available(request):
+                return None
+            # allocate resources
+            if pg_id is not None:
+                self._pg_allocate(pg_id, strategy.bundle_index, request)
+            else:
+                node.resources.allocate(request)
+            lease_id = uuid.uuid4().hex
+            self.leases[lease_id] = (node_idx, request, "", pg_id and (
+                pg_id, strategy.bundle_index))
+            # find idle worker of this class
+            idle = node.idle_by_class.get(sched_class)
+            if idle:
+                wid = idle.pop(0)
+                w = node.workers[wid]
+                w.state = "leased"
+                w.lease_id = lease_id
+                self.leases[lease_id] = (node_idx, request, wid,
+                                         self.leases[lease_id][3])
+                return w, lease_id
+            # reuse any idle worker (repurpose across scheduling classes)
+            for cls, lst in node.idle_by_class.items():
+                if lst:
+                    wid = lst.pop(0)
+                    w = node.workers[wid]
+                    w.state = "leased"
+                    w.sched_class = sched_class
+                    w.lease_id = lease_id
+                    self.leases[lease_id] = (node_idx, request, wid,
+                                             self.leases[lease_id][3])
+                    return w, lease_id
+            # spawn a new worker, re-queue the lease until it registers
+            self._spawn_worker(node, sched_class)
+            # roll back allocation; the pending lease will re-acquire
+            if pg_id is not None:
+                self._pg_release(pg_id, strategy.bundle_index, request)
+            else:
+                node.resources.release(request)
+            del self.leases[lease_id]
+            return None
+
+    def _spawn_worker(self, node: NodeState, sched_class) -> WorkerInfo:
+        cfg = get_config()
+        if len([w for w in node.workers.values() if w.state != "dead"]) >= \
+                cfg.max_workers_per_node:
+            return None  # type: ignore[return-value]
+        worker_id = uuid.uuid4().hex
+        w = WorkerInfo(worker_id=worker_id, node_idx=node.idx,
+                       sched_class=sched_class)
+        node.workers[worker_id] = w
+        env = dict(os.environ)
+        # Workers must find the ray_tpu package regardless of driver cwd.
+        import ray_tpu
+
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.abspath(ray_tpu.__file__)))
+        pp = env.get("PYTHONPATH", "")
+        if pkg_parent not in pp.split(os.pathsep):
+            env["PYTHONPATH"] = (pkg_parent + os.pathsep + pp) if pp \
+                else pkg_parent
+        env.update({
+            "RAY_TPU_WORKER_ID": worker_id,
+            "RAY_TPU_HEAD_ADDR": self.addr,
+            "RAY_TPU_NODE_IDX": str(node.idx),
+            "RAY_TPU_SESSION_DIR": self.session_dir,
+            # Workers must not grab the TPU: the driver/trainer owns devices
+            # unless a task explicitly requests TPU resources.
+            "JAX_PLATFORMS": env_jax_platform(node),
+        })
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{worker_id[:8]}.out"), "ab")
+        w.proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env, stdout=out, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        return w
+
+    def _h_return_worker(self, conn, rid, lease_id, worker_id, dispose=False):
+        with self._lock:
+            lease = self.leases.pop(lease_id, None)
+            if lease is None:
+                return
+            node_idx, request, _, pg_binding = lease
+            node = self.nodes.get(node_idx)
+            if node is None:
+                return
+            if pg_binding:
+                self._pg_release(pg_binding[0], pg_binding[1], request)
+            else:
+                node.resources.release(request)
+            w = node.workers.get(worker_id)
+            if w is not None and w.state == "leased":
+                if dispose:
+                    self._kill_worker_process(w)
+                    node.workers.pop(worker_id, None)
+                else:
+                    w.state = "idle"
+                    w.lease_id = None
+                    w.idle_since = time.monotonic()
+                    node.idle_by_class.setdefault(w.sched_class, []).append(
+                        worker_id)
+        self._try_fulfill_pending()
+
+    def _handle_worker_death(self, w: WorkerInfo):
+        with self._lock:
+            w.state = "dead"
+            node = self.nodes.get(w.node_idx)
+            if node:
+                for lst in node.idle_by_class.values():
+                    if w.worker_id in lst:
+                        lst.remove(w.worker_id)
+                if w.lease_id and w.lease_id in self.leases:
+                    node_idx, request, _, pg_binding = self.leases.pop(
+                        w.lease_id)
+                    if pg_binding:
+                        self._pg_release(pg_binding[0], pg_binding[1], request)
+                    else:
+                        node.resources.release(request)
+            actor_id = w.actor_id
+        if actor_id is not None:
+            self._on_actor_worker_death(actor_id)
+        self._publish("worker_failed", dumps(w.worker_id))
+        self._try_fulfill_pending()
+
+    # ----------------------------------------------------------- actors
+
+    def _h_create_actor(self, conn, rid, spec_bytes):
+        spec: TaskSpec = loads(spec_bytes)
+        info = ActorInfo(actor_id=spec.actor_id, spec=spec,
+                         name=spec.name or "")
+        with self._lock:
+            self.actors[spec.actor_id] = info
+            if info.name:
+                if info.name in self.named_actors:
+                    conn.reply_error(rid, ValueError(
+                        f"actor name '{info.name}' already taken"))
+                    return
+                self.named_actors[info.name] = spec.actor_id
+        self._schedule_actor(info)
+        conn.reply(rid, True, msg_type=P.CREATE_ACTOR_REPLY)
+
+    def _schedule_actor(self, info: ActorInfo):
+        """Lease a worker and push the creation task (reference:
+        GcsActorScheduler::ScheduleByGcs, gcs_actor_scheduler.cc:60)."""
+        spec = info.spec
+        request = ResourceSet(spec.resources)
+        deadline = time.monotonic() + get_config().actor_creation_timeout_s
+
+        def attempt():
+            if self._shutdown:
+                return
+            grant = self._try_grant(spec.scheduling_class(), request,
+                                    spec.strategy)
+            if grant is None:
+                if time.monotonic() > deadline:
+                    self._mark_actor_dead(info, "creation timed out (no "
+                                          "feasible node/worker)")
+                    return
+                t = threading.Timer(0.05, attempt)
+                t.daemon = True
+                t.start()
+                return
+            w, lease_id = grant
+            with self._lock:
+                w.state = "actor"
+                w.actor_id = spec.actor_id
+                info.worker_id = w.worker_id
+                info.listen_addr = w.listen_addr
+            try:
+                w.conn.send(P.PUSH_TASK, loads(dumps(spec)), 0)
+            except P.ConnectionLost:
+                self._on_actor_worker_death(spec.actor_id)
+                return
+            # ALIVE is announced only once the worker confirms the
+            # constructor ran (TASK_REPLY on its registration conn).
+
+        attempt()
+
+    def _h_creation_reply(self, conn, rid, task_id_bin, status, result_meta,
+                          err):
+        """Actor-creation completion from the actor's worker."""
+        with self._lock:
+            w = None
+            for node in self.nodes.values():
+                for cand in node.workers.values():
+                    if cand.conn is conn:
+                        w = cand
+                        break
+            if w is None or w.actor_id is None:
+                return
+            info = self.actors.get(w.actor_id)
+            if info is None:
+                return
+            if status != "ok":
+                info.state = "DEAD"
+                info.death_cause = f"creation failed: {err}"
+                waiters = list(info.pending_get_replies)
+                info.pending_get_replies.clear()
+                state, payload = "DEAD", info.death_cause
+            else:
+                info.state = "ALIVE"
+                info.listen_addr = w.listen_addr
+                waiters = list(info.pending_get_replies)
+                info.pending_get_replies.clear()
+                state, payload = "ALIVE", info.listen_addr
+        for wconn, wrid in waiters:
+            wconn.reply(wrid, state, payload, msg_type=P.GET_ACTOR_REPLY)
+        self._publish(f"actor:{w.actor_id.hex()}", dumps((state, payload)))
+
+    def _h_actor_dead(self, conn, rid, actor_id_bin, cause):
+        aid = ActorID(actor_id_bin)
+        with self._lock:
+            info = self.actors.get(aid)
+        if info is not None:
+            self._mark_actor_dead(info, cause)
+
+    def _on_actor_worker_death(self, actor_id: ActorID):
+        with self._lock:
+            info = self.actors.get(actor_id)
+            if info is None or info.state == "DEAD":
+                return
+            spec = info.spec
+            can_restart = (spec.max_restarts == -1
+                           or info.restarts_used < spec.max_restarts)
+            if can_restart:
+                info.restarts_used += 1
+                info.state = "RESTARTING"
+            else:
+                info.state = "DEAD"
+                info.death_cause = "worker died"
+        if info.state == "RESTARTING":
+            self._publish(f"actor:{actor_id.hex()}", dumps(("RESTARTING", "")))
+            self._schedule_actor(info)
+        else:
+            self._publish(f"actor:{actor_id.hex()}",
+                          dumps(("DEAD", info.death_cause)))
+
+    def _mark_actor_dead(self, info: ActorInfo, cause: str):
+        with self._lock:
+            info.state = "DEAD"
+            info.death_cause = cause
+            waiters = list(info.pending_get_replies)
+            info.pending_get_replies.clear()
+        for wconn, wrid in waiters:
+            wconn.reply(wrid, "DEAD", cause, msg_type=P.GET_ACTOR_REPLY)
+        self._publish(f"actor:{info.actor_id.hex()}", dumps(("DEAD", cause)))
+
+    def _h_get_actor(self, conn, rid, actor_id_bin_or_name):
+        with self._lock:
+            if isinstance(actor_id_bin_or_name, str):
+                aid = self.named_actors.get(actor_id_bin_or_name)
+                if aid is None:
+                    conn.reply(rid, "NOT_FOUND", "",
+                               msg_type=P.GET_ACTOR_REPLY)
+                    return
+            else:
+                aid = ActorID(actor_id_bin_or_name)
+            info = self.actors.get(aid)
+            if info is None:
+                conn.reply(rid, "NOT_FOUND", "", msg_type=P.GET_ACTOR_REPLY)
+                return
+            if info.state in ("PENDING", "RESTARTING"):
+                info.pending_get_replies.append((conn, rid))
+                return
+            state, addr = info.state, info.listen_addr
+            extra = info.death_cause if state == "DEAD" else ""
+        conn.reply(rid, state, addr if state == "ALIVE" else extra,
+                   msg_type=P.GET_ACTOR_REPLY,
+                   )
+
+    def _h_kill_actor(self, conn, rid, actor_id_bin, no_restart):
+        aid = ActorID(actor_id_bin)
+        with self._lock:
+            info = self.actors.get(aid)
+            if info is None:
+                if rid > 0:
+                    conn.reply(rid, False)
+                return
+            if no_restart:
+                info.spec.max_restarts = 0
+                info.state = "DEAD"
+                info.death_cause = "killed via kill()"
+            node = self.nodes.get(
+                next((n.idx for n in self.nodes.values()
+                      if info.worker_id in n.workers), -1))
+            w = node.workers.get(info.worker_id) if node else None
+        if w is not None:
+            self._kill_worker_process(w)
+        if no_restart:
+            self._publish(f"actor:{aid.hex()}",
+                          dumps(("DEAD", "killed via kill()")))
+        if rid > 0:
+            conn.reply(rid, True)
+
+    # ------------------------------------------------------ placement groups
+
+    def _h_create_pg(self, conn, rid, spec_bytes):
+        spec: PlacementGroupSpec = loads(spec_bytes)
+        with self._lock:
+            placement = self.scheduler.place_bundles(spec)
+            if placement is None:
+                feasible = all(
+                    any(self.nodes[i].resources.is_feasible(
+                        ResourceSet(b.resources))
+                        for i in self.scheduler.schedulable_nodes())
+                    for b in spec.bundles)
+                if not feasible:
+                    conn.reply_error(rid, RuntimeError(
+                        "placement group infeasible: no node can ever fit "
+                        "some bundle"))
+                    return
+                # retry later when resources free up
+                info = PgInfo(spec=spec)
+                self.pgs[spec.pg_id] = info
+                self._pending_pg.append(spec.pg_id)
+                conn.reply(rid, "PENDING", msg_type=P.CREATE_PG_REPLY)
+                return
+            self._commit_pg(spec, placement)
+        conn.reply(rid, "CREATED", msg_type=P.CREATE_PG_REPLY)
+
+    def _commit_pg(self, spec: PlacementGroupSpec, placement: List[int]):
+        """Reserve bundle resources on nodes (2PC prepare+commit collapses to
+        one step in-process; reference gcs_placement_group_scheduler.cc)."""
+        info = self.pgs.get(spec.pg_id) or PgInfo(spec=spec)
+        info.spec = spec
+        info.placement = placement
+        info.bundle_available = []
+        for b, node_idx in zip(spec.bundles, placement):
+            rs = ResourceSet(b.resources)
+            self.nodes[node_idx].resources.allocate(rs)
+            info.bundle_available.append(rs)
+        info.state = "CREATED"
+        self.pgs[spec.pg_id] = info
+        self._publish(f"pg:{spec.pg_id.hex()}", dumps("CREATED"))
+
+    def _retry_pending_pgs(self):
+        with self._lock:
+            pending = list(self._pending_pg)
+            for pg_id in pending:
+                info = self.pgs.get(pg_id)
+                if info is None or info.state != "PENDING":
+                    self._pending_pg.remove(pg_id)
+                    continue
+                placement = self.scheduler.place_bundles(info.spec)
+                if placement is not None:
+                    self._commit_pg(info.spec, placement)
+                    self._pending_pg.remove(pg_id)
+
+    def _h_remove_pg(self, conn, rid, pg_id_bin):
+        pg_id = PlacementGroupID(pg_id_bin)
+        with self._lock:
+            info = self.pgs.pop(pg_id, None)
+            if info and info.state == "CREATED":
+                for b, node_idx, avail in zip(info.spec.bundles,
+                                              info.placement,
+                                              info.bundle_available):
+                    node = self.nodes.get(node_idx)
+                    if node:
+                        # return whatever portion is not currently in use by
+                        # leases; in-use portions return on lease release
+                        node.resources.release(avail)
+        if rid > 0:
+            conn.reply(rid, True)
+        self._try_fulfill_pending()
+
+    def _pg_node_for(self, pg_id, bundle_index, request) -> Optional[int]:
+        info = self.pgs.get(pg_id)
+        if info is None or info.state != "CREATED":
+            return None
+        if bundle_index >= 0:
+            if info.bundle_available[bundle_index].covers(request):
+                return info.placement[bundle_index]
+            return None
+        for i, avail in enumerate(info.bundle_available):
+            if avail.covers(request):
+                return info.placement[i]
+        return None
+
+    def _pg_allocate(self, pg_id, bundle_index, request):
+        info = self.pgs[pg_id]
+        if bundle_index < 0:
+            for i, avail in enumerate(info.bundle_available):
+                if avail.covers(request):
+                    bundle_index = i
+                    break
+        info.bundle_available[bundle_index] = \
+            info.bundle_available[bundle_index].subtract(request)
+
+    def _pg_release(self, pg_id, bundle_index, request):
+        info = self.pgs.get(pg_id)
+        if info is None:
+            return
+        if bundle_index < 0:
+            bundle_index = 0
+        info.bundle_available[bundle_index] = \
+            info.bundle_available[bundle_index].add(request)
+
+    def pg_state(self, pg_id: PlacementGroupID) -> str:
+        with self._lock:
+            info = self.pgs.get(pg_id)
+            return info.state if info else "REMOVED"
+
+    def pg_placement(self, pg_id: PlacementGroupID) -> List[int]:
+        with self._lock:
+            info = self.pgs.get(pg_id)
+            return list(info.placement) if info else []
+
+    # ------------------------------------------------------------ KV store
+
+    def _h_kv_put(self, conn, rid, ns, key, value, overwrite):
+        with self._lock:
+            table = self.kv.setdefault(ns, {})
+            if not overwrite and key in table:
+                added = False
+            else:
+                table[key] = value
+                added = True
+        if rid > 0:
+            conn.reply(rid, added)
+
+    def _h_kv_get(self, conn, rid, ns, key):
+        with self._lock:
+            conn.reply(rid, self.kv.get(ns, {}).get(key))
+
+    def _h_kv_del(self, conn, rid, ns, key):
+        with self._lock:
+            existed = self.kv.get(ns, {}).pop(key, None) is not None
+        if rid > 0:
+            conn.reply(rid, existed)
+
+    def _h_kv_keys(self, conn, rid, ns, prefix):
+        with self._lock:
+            keys = [k for k in self.kv.get(ns, {}) if k.startswith(prefix)]
+        conn.reply(rid, keys)
+
+    # ------------------------------------------------------------- pubsub
+
+    def _h_subscribe(self, conn, rid, channel):
+        with self._lock:
+            self.subs.setdefault(channel, set()).add(conn)
+        if rid > 0:
+            conn.reply(rid, True)
+
+    def _h_publish(self, conn, rid, channel, payload):
+        self._publish(channel, payload)
+        if rid > 0:
+            conn.reply(rid, True)
+
+    def _publish(self, channel: str, payload: bytes):
+        with self._lock:
+            targets = list(self.subs.get(channel, ()))
+        for c in targets:
+            try:
+                c.send(P.PUBLISH, channel, payload)
+            except P.ConnectionLost:
+                with self._lock:
+                    self.subs.get(channel, set()).discard(c)
+
+    # ------------------------------------------------- object directory
+
+    def _h_object_sealed(self, conn, rid, oid_bin, node_idx, size, owner):
+        oid = ObjectID(oid_bin)
+        with self._lock:
+            loc = self.objects.setdefault(oid, _ObjLoc())
+            loc.node_idx = node_idx
+            loc.size = size
+            loc.owner = owner
+            waiters = list(loc.waiters)
+            loc.waiters.clear()
+        for wconn, wrid in waiters:
+            wconn.reply(wrid, node_idx, size, "",
+                        msg_type=P.OBJECT_LOCATE_REPLY)
+        self._maybe_spill(node_idx)
+
+    def _h_object_locate(self, conn, rid, oid_bin, block):
+        oid = ObjectID(oid_bin)
+        with self._lock:
+            loc = self.objects.get(oid)
+            if loc is not None and (loc.node_idx >= 0 or loc.spilled_path):
+                conn.reply(rid, loc.node_idx, loc.size, loc.spilled_path,
+                           msg_type=P.OBJECT_LOCATE_REPLY)
+                return
+            if not block:
+                conn.reply(rid, -1, 0, "", msg_type=P.OBJECT_LOCATE_REPLY)
+                return
+            loc = self.objects.setdefault(oid, _ObjLoc())
+            loc.waiters.append((conn, rid))
+
+    def _h_object_free(self, conn, rid, oid_bins):
+        for ob in oid_bins:
+            oid = ObjectID(ob)
+            with self._lock:
+                loc = self.objects.pop(oid, None)
+            if loc is None:
+                continue
+            if loc.spilled_path:
+                try:
+                    os.unlink(loc.spilled_path)
+                except OSError:
+                    pass
+            node = self.nodes.get(loc.node_idx)
+            if node is not None and node.alive:
+                node.store.delete(oid)
+
+    def _h_object_transfer(self, conn, rid, oid_bin, to_node_idx):
+        """Copy an object from its node's arena (or spill file) into
+        `to_node_idx`'s arena — the reference's ObjectManager chunked pull
+        (object_manager.cc), collapsed to memcpy within one host."""
+        oid = ObjectID(oid_bin)
+        with self._lock:
+            loc = self.objects.get(oid)
+        if loc is None:
+            conn.reply_error(rid, KeyError(f"object {oid.hex()} unknown"))
+            return
+        dst = self.nodes[to_node_idx].store
+        if dst.contains(oid):
+            conn.reply(rid, True)
+            return
+        cfg = get_config()
+        if loc.spilled_path:
+            with open(loc.spilled_path, "rb") as f:
+                data = f.read()
+            # spill file layout: [8B meta_len][meta][payload]
+            meta_len = int.from_bytes(data[:8], "little")
+            meta = data[8:8 + meta_len]
+            payload = data[8 + meta_len:]
+            buf = dst.create(oid, len(payload), len(meta))
+            buf[:len(payload)] = payload
+            buf[len(payload):] = meta
+            dst.seal(oid)
+        else:
+            src = self.nodes[loc.node_idx].store
+            got = src.get(oid)
+            if got is None:
+                conn.reply_error(rid, KeyError(f"object {oid.hex()} gone"))
+                return
+            data_v, meta_v = got
+            try:
+                buf = dst.create(oid, len(data_v), len(meta_v))
+                # chunked copy (mirrors 5 MiB transfer chunks)
+                cs = cfg.object_transfer_chunk_bytes
+                for off in range(0, len(data_v), cs):
+                    buf[off:off + min(cs, len(data_v) - off)] = \
+                        data_v[off:off + cs]
+                buf[len(data_v):] = meta_v
+                dst.seal(oid)
+            finally:
+                del data_v, meta_v, got
+                src.release(oid)
+        conn.reply(rid, True)
+
+    # --------------------------------------------------------- spilling
+
+    def _maybe_spill(self, node_idx: int):
+        """Spill cold sealed objects to disk when the arena crosses the
+        threshold (reference: LocalObjectManager::SpillObjects,
+        local_object_manager.h:110; FileSystemStorage external_storage.py)."""
+        cfg = get_config()
+        node = self.nodes.get(node_idx)
+        if node is None:
+            return
+        store = node.store
+        if store.bytes_in_use() < cfg.object_spilling_threshold * \
+                store.capacity():
+            return
+        spill_dir = cfg.spill_dir or os.path.join(self.session_dir, "spill")
+        os.makedirs(spill_dir, exist_ok=True)
+        with self._lock:
+            candidates = [
+                (oid, loc) for oid, loc in self.objects.items()
+                if loc.node_idx == node_idx and not loc.spilled_path
+            ]
+        target = store.capacity() * (cfg.object_spilling_threshold - 0.2)
+        for oid, loc in candidates:
+            if store.bytes_in_use() <= target:
+                break
+            got = store.get(oid)
+            if got is None:
+                continue
+            data_v, meta_v = got
+            path = os.path.join(spill_dir, oid.hex())
+            try:
+                with open(path, "wb") as f:
+                    f.write(len(meta_v).to_bytes(8, "little"))
+                    f.write(meta_v)
+                    f.write(data_v)
+            finally:
+                del data_v, meta_v, got
+                store.release(oid)
+            with self._lock:
+                loc.spilled_path = path
+                loc.node_idx = -1
+            store.delete(oid)
+
+    # ------------------------------------------------------------ cluster info
+
+    def _h_node_info(self, conn, rid):
+        with self._lock:
+            infos = [{
+                "node_idx": n.idx,
+                "alive": n.alive,
+                "resources_total": n.resources.total.to_dict(),
+                "resources_available": n.resources.available.to_dict(),
+                "store_name": n.store_name,
+                "num_workers": len([w for w in n.workers.values()
+                                    if w.state != "dead"]),
+                "labels": n.resources.labels,
+                "tpu": n.resources.tpu,
+            } for n in self.nodes.values()]
+        conn.reply(rid, infos, msg_type=P.NODE_INFO_REPLY)
+
+    def _h_drain_node(self, conn, rid, node_idx):
+        with self._lock:
+            self.scheduler.drain_node(node_idx)
+        if rid > 0:
+            conn.reply(rid, True)
+
+    def _h_ping(self, conn, rid):
+        conn.reply(rid, "pong")
+
+    def _h_worker_exit(self, conn, rid):
+        pass  # connection close handles cleanup
+
+    _HANDLERS = {
+        P.REGISTER: _h_register,
+        P.LEASE_REQUEST: _h_lease_request,
+        P.RETURN_WORKER: _h_return_worker,
+        P.CREATE_ACTOR: _h_create_actor,
+        P.GET_ACTOR: _h_get_actor,
+        P.KILL_ACTOR: _h_kill_actor,
+        P.CREATE_PG: _h_create_pg,
+        P.REMOVE_PG: _h_remove_pg,
+        P.KV_PUT: _h_kv_put,
+        P.KV_GET: _h_kv_get,
+        P.KV_DEL: _h_kv_del,
+        P.KV_KEYS: _h_kv_keys,
+        P.SUBSCRIBE: _h_subscribe,
+        P.PUBLISH: _h_publish,
+        P.OBJECT_SEALED: _h_object_sealed,
+        P.OBJECT_LOCATE: _h_object_locate,
+        P.OBJECT_FREE: _h_object_free,
+        P.OBJECT_TRANSFER: _h_object_transfer,
+        P.NODE_INFO: _h_node_info,
+        P.DRAIN_NODE: _h_drain_node,
+        P.PING: _h_ping,
+        P.WORKER_EXIT: _h_worker_exit,
+        P.TASK_REPLY: _h_creation_reply,
+        P.ACTOR_DEAD: _h_actor_dead,
+        P.BORROW_ADD: lambda self, conn, rid, oid, owner, borrower:
+            self._forward_to_worker(owner, P.BORROW_ADD, oid, borrower),
+        P.BORROW_REMOVE: lambda self, conn, rid, oid, owner, borrower:
+            self._forward_to_worker(owner, P.BORROW_REMOVE, oid, borrower),
+    }
+
+    def _forward_to_worker(self, worker_id: str, mt: int, *fields):
+        with self._lock:
+            for node in self.nodes.values():
+                w = node.workers.get(worker_id)
+                if w is not None and w.conn is not None:
+                    conn = w.conn
+                    break
+            else:
+                return
+        try:
+            conn.send(mt, *fields)
+        except P.ConnectionLost:
+            pass
+
+    # ------------------------------------------------------------ lifecycle
+
+    def periodic(self):
+        """Housekeeping: PG retries, idle worker reaping. Called by driver."""
+        self._retry_pending_pgs()
+        self._try_fulfill_pending()
+        cfg = get_config()
+        now = time.monotonic()
+        with self._lock:
+            for node in self.nodes.values():
+                for cls, lst in list(node.idle_by_class.items()):
+                    keep = []
+                    for wid in lst:
+                        w = node.workers[wid]
+                        if now - w.idle_since > cfg.idle_worker_keep_alive_s:
+                            self._kill_worker_process(w)
+                            node.workers.pop(wid, None)
+                        else:
+                            keep.append(wid)
+                    node.idle_by_class[cls] = keep
+
+    def shutdown(self):
+        self._shutdown = True
+        with self._lock:
+            workers = [w for n in self.nodes.values()
+                       for w in n.workers.values()]
+        for w in workers:
+            self._kill_worker_process(w)
+        for w in workers:
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=2)
+                except subprocess.TimeoutExpired:
+                    pass
+        self.io.stop()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for n in self.nodes.values():
+            try:
+                n.store.close()
+            except Exception:
+                pass
+        self.nodes.clear()
+
+
+def env_jax_platform(node: NodeState) -> str:
+    """Workers on TPU-less logical nodes must not touch the TPU runtime."""
+    if node.resources.total.get("TPU") > 0:
+        return os.environ.get("JAX_PLATFORMS", "")
+    return "cpu"

@@ -1,0 +1,105 @@
+"""In-process object store for resolved values and pending futures.
+
+Analog of the reference's ``CoreWorkerMemoryStore``
+(src/ray/core_worker/store_provider/memory_store/memory_store.h:43): holds
+small/inlined objects and completed results locally so ``get`` on them never
+touches the shared-memory store; unresolved ids carry waiter lists.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .ids import ObjectID
+
+
+class _Entry:
+    __slots__ = ("ready", "value", "is_error", "in_plasma", "node_idx")
+
+    def __init__(self):
+        self.ready = False
+        self.value = None
+        self.is_error = False
+        self.in_plasma = False
+        self.node_idx = -1
+
+
+class MemoryStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._entries: Dict[ObjectID, _Entry] = {}
+        self._callbacks: Dict[ObjectID, List[Callable]] = {}
+
+    def put_value(self, oid: ObjectID, value: Any, is_error: bool = False):
+        with self._cv:
+            e = self._entries.setdefault(oid, _Entry())
+            e.ready = True
+            e.value = value
+            e.is_error = is_error
+            cbs = self._callbacks.pop(oid, [])
+            self._cv.notify_all()
+        for cb in cbs:
+            cb()
+
+    def put_plasma_location(self, oid: ObjectID, node_idx: int):
+        """Record that the value lives in node `node_idx`'s shm store."""
+        with self._cv:
+            e = self._entries.setdefault(oid, _Entry())
+            e.ready = True
+            e.in_plasma = True
+            e.node_idx = node_idx
+            cbs = self._callbacks.pop(oid, [])
+            self._cv.notify_all()
+        for cb in cbs:
+            cb()
+
+    def contains(self, oid: ObjectID) -> bool:
+        with self._lock:
+            e = self._entries.get(oid)
+            return e is not None and e.ready
+
+    def peek(self, oid: ObjectID) -> Optional[_Entry]:
+        with self._lock:
+            e = self._entries.get(oid)
+            return e if (e and e.ready) else None
+
+    def wait_ready(self, oids: Sequence[ObjectID], num_returns: int,
+                   timeout: Optional[float]) -> List[ObjectID]:
+        """Block until `num_returns` of `oids` are ready; returns ready list."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                ready = [o for o in oids
+                         if (e := self._entries.get(o)) and e.ready]
+                if len(ready) >= num_returns:
+                    return ready[:num_returns] if num_returns < len(ready) else ready
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return ready
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait(1.0)
+
+    def add_ready_callback(self, oid: ObjectID, cb: Callable):
+        fire = False
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is not None and e.ready:
+                fire = True
+            else:
+                self._callbacks.setdefault(oid, []).append(cb)
+        if fire:
+            cb()
+
+    def evict(self, oid: ObjectID):
+        with self._lock:
+            self._entries.pop(oid, None)
+
+    def num_entries(self) -> int:
+        with self._lock:
+            return len(self._entries)

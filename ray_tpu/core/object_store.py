@@ -1,0 +1,211 @@
+"""Python client for the native shared-memory object store.
+
+Wraps ray_tpu/native/shm_store.cc (the plasma analog —
+src/ray/object_manager/plasma/client.cc in the reference) via ctypes. The
+client maps the segment once; object payloads are read/written through
+zero-copy memoryviews over that mapping. Serialization uses pickle protocol 5
+with out-of-band buffers so numpy / jax host arrays round-trip without extra
+copies.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import pickle
+import threading
+from typing import List, Optional, Tuple
+
+from .ids import ObjectID
+
+_ID_SIZE = 20
+
+
+class ObjectStoreFullError(Exception):
+    pass
+
+
+class ObjectExistsError(Exception):
+    pass
+
+
+def _load_lib():
+    from ray_tpu.native.build import lib_path
+
+    lib = ctypes.CDLL(lib_path("libshm_store.so"))
+    lib.shm_store_create.restype = ctypes.c_void_p
+    lib.shm_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.shm_store_attach.restype = ctypes.c_void_p
+    lib.shm_store_attach.argtypes = [ctypes.c_char_p]
+    lib.shm_store_detach.argtypes = [ctypes.c_void_p]
+    lib.shm_store_destroy.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_store_create_object.restype = ctypes.c_int64
+    lib.shm_store_create_object.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+    lib.shm_store_seal.restype = ctypes.c_int
+    lib.shm_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_store_get.restype = ctypes.c_int
+    lib.shm_store_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
+    lib.shm_store_contains.restype = ctypes.c_int
+    lib.shm_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_store_release.restype = ctypes.c_int
+    lib.shm_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_store_delete.restype = ctypes.c_int
+    lib.shm_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_store_evict.restype = ctypes.c_int
+    lib.shm_store_evict.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int]
+    lib.shm_store_bytes_in_use.restype = ctypes.c_uint64
+    lib.shm_store_bytes_in_use.argtypes = [ctypes.c_void_p]
+    lib.shm_store_capacity.restype = ctypes.c_uint64
+    lib.shm_store_capacity.argtypes = [ctypes.c_void_p]
+    lib.shm_store_num_objects.restype = ctypes.c_uint64
+    lib.shm_store_num_objects.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def get_lib():
+    global _lib
+    if _lib is None:
+        with _lib_lock:
+            if _lib is None:
+                _lib = _load_lib()
+    return _lib
+
+
+class ShmObjectStore:
+    """One node's shared-memory object store (creator or attacher)."""
+
+    def __init__(self, name: str, capacity: int = 0, create: bool = False):
+        self.name = name
+        self._cname = name.encode()
+        lib = get_lib()
+        if create:
+            self._h = lib.shm_store_create(self._cname, capacity)
+        else:
+            self._h = lib.shm_store_attach(self._cname)
+        if not self._h:
+            raise RuntimeError(
+                f"Failed to {'create' if create else 'attach'} shm store {name}")
+        self._creator = create
+        # Map the segment for data access (metadata is managed by the C side).
+        fd = os.open(f"/dev/shm/{name}", os.O_RDWR)
+        try:
+            self._mmap = mmap.mmap(fd, 0)
+        finally:
+            os.close(fd)
+        self._closed = False
+        self._lock = threading.Lock()
+
+    # -- raw object interface -------------------------------------------------
+
+    def create(self, object_id: ObjectID, data_size: int, meta_size: int = 0
+               ) -> memoryview:
+        lib = get_lib()
+        off = lib.shm_store_create_object(
+            self._h, object_id.binary(), data_size, meta_size)
+        if off == -1:
+            raise ObjectExistsError(object_id.hex())
+        if off == 0:
+            # Try eviction, then retry once.
+            self.evict(data_size + meta_size)
+            off = lib.shm_store_create_object(
+                self._h, object_id.binary(), data_size, meta_size)
+            if off <= 0:
+                raise ObjectStoreFullError(
+                    f"store {self.name} full: need {data_size + meta_size}, "
+                    f"in use {self.bytes_in_use()}/{self.capacity()}")
+        return memoryview(self._mmap)[off:off + data_size + meta_size]
+
+    def seal(self, object_id: ObjectID):
+        if get_lib().shm_store_seal(self._h, object_id.binary()) != 0:
+            raise KeyError(f"seal failed for {object_id.hex()}")
+
+    def get(self, object_id: ObjectID) -> Optional[Tuple[memoryview, memoryview]]:
+        """Returns (data, metadata) views, pinning the object; None if absent."""
+        out = (ctypes.c_uint64 * 3)()
+        rc = get_lib().shm_store_get(self._h, object_id.binary(), out)
+        if rc != 0:
+            return None
+        off, dsize, msize = out[0], out[1], out[2]
+        mv = memoryview(self._mmap)
+        return mv[off:off + dsize], mv[off + dsize:off + dsize + msize]
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return get_lib().shm_store_contains(self._h, object_id.binary()) == 1
+
+    def release(self, object_id: ObjectID):
+        get_lib().shm_store_release(self._h, object_id.binary())
+
+    def delete(self, object_id: ObjectID) -> bool:
+        return get_lib().shm_store_delete(self._h, object_id.binary()) == 0
+
+    def evict(self, need: int) -> List[ObjectID]:
+        buf = ctypes.create_string_buffer(_ID_SIZE * 256)
+        n = get_lib().shm_store_evict(self._h, need, buf, 256)
+        return [
+            ObjectID(buf.raw[i * _ID_SIZE:(i + 1) * _ID_SIZE]) for i in range(n)
+        ]
+
+    def bytes_in_use(self) -> int:
+        return get_lib().shm_store_bytes_in_use(self._h)
+
+    def capacity(self) -> int:
+        return get_lib().shm_store_capacity(self._h)
+
+    def num_objects(self) -> int:
+        return get_lib().shm_store_num_objects(self._h)
+
+    # -- serialized-value interface ------------------------------------------
+
+    def put_serialized(self, object_id: ObjectID, frames: List[bytes]) -> int:
+        """Store pre-serialized frames (header + oob buffers), return bytes."""
+        sizes = [len(f) for f in frames]
+        meta = pickle.dumps(sizes, protocol=5)
+        total = sum(sizes)
+        buf = self.create(object_id, total, len(meta))
+        pos = 0
+        for f in frames:
+            buf[pos:pos + len(f)] = f
+            pos += len(f)
+        buf[total:] = meta
+        self.seal(object_id)
+        return total + len(meta)
+
+    def get_frames(self, object_id: ObjectID) -> Optional[List[memoryview]]:
+        got = self.get(object_id)
+        if got is None:
+            return None
+        data, meta = got
+        sizes = pickle.loads(bytes(meta))
+        frames, pos = [], 0
+        for s in sizes:
+            frames.append(data[pos:pos + s])
+            pos += s
+        return frames
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._mmap.close()
+        except BufferError:
+            pass  # zero-copy views still alive; leave the map
+        lib = get_lib()
+        if self._creator:
+            lib.shm_store_destroy(self._h, self._cname)
+        else:
+            lib.shm_store_detach(self._h)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

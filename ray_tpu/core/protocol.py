@@ -1,0 +1,332 @@
+"""Wire protocol: framed messages over unix-domain sockets.
+
+Analog role: the reference's gRPC services (src/ray/rpc/, 25 protos). On a
+TPU pod the control plane is host-to-host over DCN; here we implement the
+same message surface over length-prefixed pickled frames on unix/TCP sockets
+— a head connection per process (GCS+raylet client) plus direct
+worker-to-worker connections for task/actor push (the reference's
+CoreWorkerService PushTask, core_worker.proto:415).
+
+Messages are tuples ``(msg_type, request_id, *fields)``. ``request_id`` > 0
+means a reply is expected (RPC); 0 means one-way.
+"""
+
+from __future__ import annotations
+
+import itertools
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+_LEN = struct.Struct("<I")
+
+# --- message types ---------------------------------------------------------
+# worker <-> head (GCS + raylet services)
+REGISTER = 1            # (worker_id_hex, pid, listen_addr, node_idx)
+REGISTER_REPLY = 2
+LEASE_REQUEST = 3       # (sched_class_key, resources_dict, job_id_hex, strategy)
+LEASE_REPLY = 4         # (ok, worker_id_hex, listen_addr, lease_id, err)
+RETURN_WORKER = 5       # (lease_id, worker_id_hex)
+CREATE_ACTOR = 6        # (actor_spec_bytes)
+CREATE_ACTOR_REPLY = 7
+GET_ACTOR = 8           # (actor_id_binary)
+GET_ACTOR_REPLY = 9     # (state, listen_addr)
+KV_PUT = 10             # (ns, key, value, overwrite)
+KV_GET = 11             # (ns, key)
+KV_DEL = 12
+KV_KEYS = 13
+SUBSCRIBE = 14          # (channel,)
+PUBLISH = 15            # (channel, payload)
+OBJECT_SEALED = 16      # (object_id_bin, node_idx, size, owner_hex)
+OBJECT_LOCATE = 17      # (object_id_bin)
+OBJECT_LOCATE_REPLY = 18  # (node_idx or -1, size, spilled_url)
+OBJECT_FREE = 19        # (object_id_bins,)
+BORROW_ADD = 20         # (object_id_bin, borrower_hex)
+BORROW_REMOVE = 21
+CREATE_PG = 22          # (pg_spec_bytes)
+CREATE_PG_REPLY = 23
+REMOVE_PG = 24
+ACTOR_DEAD = 25         # notification (actor_id_bin, err)
+KILL_ACTOR = 26         # (actor_id_bin, no_restart)
+NODE_INFO = 27          # request cluster node table
+NODE_INFO_REPLY = 28
+DRAIN_NODE = 29
+OBJECT_TRANSFER = 30    # (object_id_bin, to_node_idx) - ask head to arrange
+OBJECT_CHUNK = 31       # (object_id_bin, chunk_idx, n_chunks, payload)
+WORKER_EXIT = 32        # worker announces clean exit
+CANCEL_TASK = 33        # (task_id_bin, force)
+ERROR_REPLY = 34
+TASK_EVENTS = 35        # (events_list,) buffered task state events -> GCS
+JOB_SUBMIT = 36
+PING = 37
+OK = 38
+
+# worker <-> worker (direct transport)
+PUSH_TASK = 50          # (task_spec_bytes, seqno)
+TASK_REPLY = 51         # (task_id_bin, status, result_meta, err)  [rpc reply]
+STEAL_BACK = 52
+PUSH_CANCEL = 53        # (task_id_bin, force)
+
+
+class ConnectionLost(Exception):
+    pass
+
+
+class Connection:
+    """A framed, thread-safe duplex connection.
+
+    Reads are driven by the owning IOLoop (or a dedicated thread); writes may
+    come from any thread. Supports request/reply with blocking ``call``.
+    """
+
+    _req_counter = itertools.count(1)
+
+    def __init__(self, sock: socket.socket, peer: str = ""):
+        self.sock = sock
+        self.peer = peer
+        self._wlock = threading.Lock()
+        self._pending: Dict[int, "_Waiter"] = {}
+        self._pending_lock = threading.Lock()
+        self._rbuf = bytearray()
+        self.closed = False
+        self.on_close: Optional[Callable[["Connection"], None]] = None
+        self._ioloop: Optional["IOLoop"] = None
+        sock.setblocking(True)
+
+    # -- send side --
+
+    def send(self, msg_type: int, *fields, request_id: int = 0):
+        payload = pickle.dumps((msg_type, request_id, *fields), protocol=5)
+        data = _LEN.pack(len(payload)) + payload
+        with self._wlock:
+            if self.closed:
+                raise ConnectionLost(self.peer)
+            try:
+                self.sock.sendall(data)
+            except OSError as e:
+                raise ConnectionLost(f"{self.peer}: {e}") from e
+
+    def call(self, msg_type: int, *fields, timeout: Optional[float] = None):
+        """Send a request and block for its reply; returns reply fields."""
+        rid = next(self._req_counter)
+        w = _Waiter()
+        with self._pending_lock:
+            self._pending[rid] = w
+        try:
+            self.send(msg_type, *fields, request_id=rid)
+            if not w.event.wait(timeout):
+                raise TimeoutError(f"RPC {msg_type} to {self.peer} timed out")
+            if w.error is not None:
+                raise w.error
+            return w.value
+        finally:
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+
+    def reply(self, request_id: int, *fields, msg_type: int = OK):
+        self.send(msg_type, *fields, request_id=-request_id)
+
+    def reply_error(self, request_id: int, err: BaseException):
+        self.send(ERROR_REPLY, err, request_id=-request_id)
+
+    # -- receive side --
+
+    def feed(self, data: bytes):
+        """Feed raw bytes; yields complete messages."""
+        self._rbuf += data
+        msgs = []
+        while True:
+            if len(self._rbuf) < 4:
+                break
+            (ln,) = _LEN.unpack_from(self._rbuf)
+            if len(self._rbuf) < 4 + ln:
+                break
+            payload = bytes(self._rbuf[4:4 + ln])
+            del self._rbuf[:4 + ln]
+            msgs.append(pickle.loads(payload))
+        return msgs
+
+    def dispatch_reply(self, msg) -> bool:
+        """If msg is a reply to a pending call, complete it. Returns True."""
+        request_id = msg[1]
+        if request_id >= 0:
+            return False
+        rid = -request_id
+        with self._pending_lock:
+            w = self._pending.get(rid)
+        if w is None:
+            return True  # stale reply
+        if msg[0] == ERROR_REPLY:
+            w.error = msg[2]
+        else:
+            w.value = msg[2:]
+        w.event.set()
+        return True
+
+    def close(self):
+        if self.closed:
+            return
+        self.closed = True
+        # Unregister from the IO loop BEFORE closing the fd — once closed the
+        # fd number can be recycled by a new socket.
+        if self._ioloop is not None:
+            self._ioloop.remove(self.sock)
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        with self._pending_lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for w in pending:
+            w.error = ConnectionLost(self.peer)
+            w.event.set()
+        if self.on_close:
+            try:
+                self.on_close(self)
+            except Exception:
+                pass
+
+
+class _Waiter:
+    __slots__ = ("event", "value", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+        self.error = None
+
+
+class IOLoop:
+    """Single IO thread multiplexing all connections of a process.
+
+    Mirrors the reference's per-process ``instrumented_io_context`` asio loop
+    (src/ray/common/asio/instrumented_io_context.h).
+    """
+
+    def __init__(self, name: str = "io"):
+        import selectors
+
+        self.sel = selectors.DefaultSelector()
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._stopped = threading.Event()
+        self._wakeup_r, self._wakeup_w = socket.socketpair()
+        self._wakeup_r.setblocking(False)
+        self.sel.register(self._wakeup_r, 1, ("wakeup", None, None))
+        self._started = False
+
+    def start(self):
+        if not self._started:
+            self._started = True
+            self._thread.start()
+
+    def add_listener(self, sock: socket.socket,
+                     on_accept: Callable[[socket.socket, Any], None]):
+        sock.setblocking(False)
+        with self._lock:
+            self.sel.register(sock, 1, ("listen", on_accept, None))
+        self._wake()
+
+    def add_connection(self, conn: Connection,
+                       on_message: Callable[[Connection, Tuple], None]):
+        conn.sock.setblocking(False)
+        conn._ioloop = self
+        with self._lock:
+            self.sel.register(conn.sock, 1, ("conn", on_message, conn))
+        self._wake()
+
+    def remove(self, sock):
+        with self._lock:
+            try:
+                self.sel.unregister(sock)
+            except (KeyError, ValueError):
+                pass
+
+    def _wake(self):
+        try:
+            self._wakeup_w.send(b"x")
+        except OSError:
+            pass
+
+    def _run(self):
+        while not self._stopped.is_set():
+            try:
+                events = self.sel.select(timeout=0.5)
+            except OSError:
+                continue
+            for key, _ in events:
+                kind, cb, conn = key.data
+                if kind == "wakeup":
+                    try:
+                        self._wakeup_r.recv(4096)
+                    except OSError:
+                        pass
+                elif kind == "listen":
+                    try:
+                        client, addr = key.fileobj.accept()
+                        cb(client, addr)
+                    except OSError:
+                        pass
+                elif kind == "conn":
+                    self._service_conn(key.fileobj, cb, conn)
+
+    def _service_conn(self, sock, on_message, conn: Connection):
+        try:
+            data = sock.recv(1 << 20)
+        except BlockingIOError:
+            return
+        except OSError:
+            data = b""
+        if not data:
+            self.remove(sock)
+            conn.close()
+            return
+        for msg in conn.feed(data):
+            if conn.dispatch_reply(msg):
+                continue
+            try:
+                on_message(conn, msg)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def stop(self):
+        self._stopped.set()
+        self._wake()
+        if self._started:
+            self._thread.join(timeout=2)
+        try:
+            self.sel.close()
+        except Exception:
+            pass
+
+
+def listen_unix(path: str) -> socket.socket:
+    import os
+
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.bind(path)
+    s.listen(128)
+    return s
+
+
+def connect_addr(addr: str, timeout: float = 10.0) -> socket.socket:
+    """addr: 'unix:<path>' or 'tcp:<host>:<port>'."""
+    if addr.startswith("unix:"):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(timeout)
+        s.connect(addr[5:])
+    else:
+        _, host, port = addr.split(":")
+        s = socket.create_connection((host, int(port)), timeout=timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    s.settimeout(None)
+    return s

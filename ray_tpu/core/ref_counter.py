@@ -1,0 +1,125 @@
+"""Distributed reference counting for object GC.
+
+Analog of the reference's ``ReferenceCounter``
+(src/ray/core_worker/reference_count.h:61, ~1.6k LoC) — the owner of each
+object tracks (a) its own process-local Python refs, (b) submitted-task
+arguments in flight, and (c) remote borrowers. When all three hit zero the
+object is freed from the shared-memory store cluster-wide. Borrowers report
+via BORROW_ADD/BORROW_REMOVE control messages (the reference uses the
+WaitForRefRemoved pubsub protocol).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Set
+
+from .ids import ObjectID
+
+
+class _Count:
+    __slots__ = ("local", "task_args", "borrowers", "owned", "freed")
+
+    def __init__(self):
+        self.local = 0
+        self.task_args = 0
+        self.borrowers: Set[str] = set()
+        self.owned = False
+        self.freed = False
+
+    def total(self) -> int:
+        return self.local + self.task_args + len(self.borrowers)
+
+
+class ReferenceCounter:
+    def __init__(self, my_id: str,
+                 free_callback: Callable[[ObjectID], None],
+                 borrow_release_callback: Callable[[ObjectID, str], None]):
+        """free_callback: invoked (owner side) when an owned object's count
+        hits zero. borrow_release_callback(oid, owner): invoked (borrower
+        side) when our local refs on a borrowed object hit zero."""
+        self._my_id = my_id
+        self._lock = threading.Lock()
+        self._counts: Dict[ObjectID, _Count] = {}
+        self._free_cb = free_callback
+        self._borrow_release_cb = borrow_release_callback
+        self._owners: Dict[ObjectID, Optional[str]] = {}
+
+    def add_owned(self, oid: ObjectID):
+        with self._lock:
+            c = self._counts.setdefault(oid, _Count())
+            c.owned = True
+
+    def add_local_ref(self, ref) -> None:
+        with self._lock:
+            c = self._counts.setdefault(ref.id, _Count())
+            c.local += 1
+            if not c.owned:
+                self._owners[ref.id] = ref.owner
+
+    def remove_local_ref(self, ref) -> None:
+        to_free = None
+        borrow_release = None
+        with self._lock:
+            c = self._counts.get(ref.id)
+            if c is None:
+                return
+            c.local -= 1
+            if c.local <= 0 and c.task_args == 0:
+                if c.owned and not c.borrowers and not c.freed:
+                    c.freed = True
+                    to_free = ref.id
+                    self._counts.pop(ref.id, None)
+                elif not c.owned:
+                    owner = self._owners.pop(ref.id, None)
+                    self._counts.pop(ref.id, None)
+                    if owner:
+                        borrow_release = (ref.id, owner)
+        if to_free is not None:
+            self._free_cb(to_free)
+        if borrow_release is not None:
+            self._borrow_release_cb(*borrow_release)
+
+    def add_task_arg(self, oid: ObjectID):
+        with self._lock:
+            c = self._counts.setdefault(oid, _Count())
+            c.task_args += 1
+
+    def remove_task_arg(self, oid: ObjectID):
+        to_free = None
+        with self._lock:
+            c = self._counts.get(oid)
+            if c is None:
+                return
+            c.task_args -= 1
+            if c.total() <= 0 and c.owned and not c.freed:
+                c.freed = True
+                to_free = oid
+                self._counts.pop(oid, None)
+        if to_free is not None:
+            self._free_cb(to_free)
+
+    # owner side: borrower registration
+    def add_borrower(self, oid: ObjectID, borrower: str):
+        with self._lock:
+            c = self._counts.setdefault(oid, _Count())
+            c.owned = True
+            c.borrowers.add(borrower)
+
+    def remove_borrower(self, oid: ObjectID, borrower: str):
+        to_free = None
+        with self._lock:
+            c = self._counts.get(oid)
+            if c is None:
+                return
+            c.borrowers.discard(borrower)
+            if c.total() <= 0 and c.owned and not c.freed:
+                c.freed = True
+                to_free = oid
+                self._counts.pop(oid, None)
+        if to_free is not None:
+            self._free_cb(to_free)
+
+    def num_tracked(self) -> int:
+        with self._lock:
+            return len(self._counts)

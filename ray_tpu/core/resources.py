@@ -1,0 +1,267 @@
+"""Resource model: fixed-point resource vectors and per-node accounting.
+
+Analog of the reference's scheduling resource model
+(src/ray/common/scheduling/cluster_resource_data.h — ``ResourceRequest``,
+``TaskResourceInstances``, ``NodeResources``; fixed_point.h). Resources are
+fixed-point (1/10000 granularity) so fractional accelerators account exactly.
+
+TPU-first: ``TPU`` is a first-class resource alongside CPU/memory, and nodes
+carry TPU topology labels (accelerator type, slice name, worker index within
+the slice, ICI coordinates) so placement groups can do ICI-topology-aware
+STRICT_PACK — a pod-slice bundle maps to a contiguous slice of the torus.
+The reference snapshot has no TPU resource at all (SURVEY.md §2.3); its GPU
+handling lives in python/ray/_private/resource_spec.py:303 and
+src/ray/common/scheduling/*.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Optional
+
+GRANULARITY = 10000
+
+CPU = "CPU"
+GPU = "GPU"
+TPU = "TPU"
+MEMORY = "memory"
+OBJECT_STORE_MEMORY = "object_store_memory"
+
+PREDEFINED = (CPU, GPU, TPU, MEMORY, OBJECT_STORE_MEMORY)
+
+
+def _to_fp(v: float) -> int:
+    return round(v * GRANULARITY)
+
+
+def _from_fp(v: int) -> float:
+    return v / GRANULARITY
+
+
+class ResourceSet:
+    """An immutable-ish map of resource name -> fixed-point quantity."""
+
+    __slots__ = ("_fp",)
+
+    def __init__(self, resources: Optional[Dict[str, float]] = None, _fp=None):
+        if _fp is not None:
+            self._fp = _fp
+        else:
+            self._fp = {}
+            if resources:
+                for k, v in resources.items():
+                    if v < 0:
+                        raise ValueError(f"Negative resource {k}={v}")
+                    fp = _to_fp(v)
+                    if fp:
+                        self._fp[k] = fp
+
+    def get(self, name: str) -> float:
+        return _from_fp(self._fp.get(name, 0))
+
+    def get_fp(self, name: str) -> int:
+        return self._fp.get(name, 0)
+
+    def names(self) -> Iterable[str]:
+        return self._fp.keys()
+
+    def is_empty(self) -> bool:
+        return not self._fp
+
+    def to_dict(self) -> Dict[str, float]:
+        return {k: _from_fp(v) for k, v in self._fp.items()}
+
+    def covers(self, request: "ResourceSet") -> bool:
+        """True if self has at least the quantities in `request`."""
+        for k, v in request._fp.items():
+            if self._fp.get(k, 0) < v:
+                return False
+        return True
+
+    def add(self, other: "ResourceSet") -> "ResourceSet":
+        fp = dict(self._fp)
+        for k, v in other._fp.items():
+            fp[k] = fp.get(k, 0) + v
+        return ResourceSet(_fp=fp)
+
+    def subtract(self, other: "ResourceSet") -> "ResourceSet":
+        fp = dict(self._fp)
+        for k, v in other._fp.items():
+            nv = fp.get(k, 0) - v
+            if nv < 0:
+                raise ValueError(f"Resource {k} would go negative")
+            if nv:
+                fp[k] = nv
+            else:
+                fp.pop(k, None)
+        return ResourceSet(_fp=fp)
+
+    def scaled(self, factor: float) -> "ResourceSet":
+        return ResourceSet(_fp={k: round(v * factor) for k, v in self._fp.items()})
+
+    def __eq__(self, other):
+        return isinstance(other, ResourceSet) and self._fp == other._fp
+
+    def __repr__(self):
+        return f"ResourceSet({self.to_dict()})"
+
+
+@dataclass
+class TpuTopology:
+    """TPU topology attached to a node.
+
+    ``coords`` is this host's position in the slice's host grid; ``chips``
+    the number of chips local to the host. STRICT_PACK bundle scheduling uses
+    these to pick hosts forming a contiguous ICI sub-torus.
+    """
+
+    accelerator_type: str = ""  # e.g. "v5p-64"
+    slice_name: str = ""
+    worker_index: int = 0
+    num_workers: int = 1
+    chips_per_host: int = 4
+    coords: tuple = (0, 0, 0)
+
+    @property
+    def generation(self) -> str:
+        return self.accelerator_type.split("-")[0] if self.accelerator_type else ""
+
+
+@dataclass
+class NodeResources:
+    """Total and available resources on one node, plus labels."""
+
+    node_id: object = None
+    total: ResourceSet = field(default_factory=ResourceSet)
+    available: ResourceSet = field(default_factory=ResourceSet)
+    labels: Dict[str, str] = field(default_factory=dict)
+    tpu: Optional[TpuTopology] = None
+
+    def is_feasible(self, request: ResourceSet) -> bool:
+        return self.total.covers(request)
+
+    def is_available(self, request: ResourceSet) -> bool:
+        return self.available.covers(request)
+
+    def allocate(self, request: ResourceSet):
+        self.available = self.available.subtract(request)
+
+    def release(self, request: ResourceSet):
+        self.available = self.available.add(request)
+        # Guard against double-release drifting above total.
+        for k in list(self.available.names()):
+            if self.available.get_fp(k) > self.total.get_fp(k):
+                raise ValueError(f"Released more {k} than total on node")
+
+    def utilization(self) -> float:
+        """Max utilization across critical resources — drives hybrid policy."""
+        util = 0.0
+        for k in (CPU, GPU, TPU, MEMORY):
+            tot = self.total.get_fp(k)
+            if tot:
+                util = max(util, 1.0 - self.available.get_fp(k) / tot)
+        return util
+
+
+def detect_node_resources(num_cpus=None, num_tpus=None, memory=None,
+                          object_store_memory=None, resources=None,
+                          labels=None) -> NodeResources:
+    """Autodetect this host's resources (analog of resource_spec.py).
+
+    TPU detection: query jax for local device count when a TPU platform is
+    present; honor explicit overrides first.
+    """
+    import os
+
+    res = dict(resources or {})
+    if num_cpus is None:
+        num_cpus = os.cpu_count() or 1
+    res[CPU] = num_cpus
+    if num_tpus is None:
+        num_tpus = _detect_tpu_chips()
+    if num_tpus:
+        res[TPU] = num_tpus
+    if memory is None:
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemAvailable"):
+                        memory = int(line.split()[1]) * 1024 // 2
+                        break
+        except OSError:
+            memory = 4 * 1024 * 1024 * 1024
+    res[MEMORY] = memory
+    if object_store_memory is not None:
+        res[OBJECT_STORE_MEMORY] = object_store_memory
+    rs = ResourceSet(res)
+    return NodeResources(total=rs, available=rs, labels=dict(labels or {}),
+                         tpu=detect_tpu_topology())
+
+
+_tpu_chips_cache = None
+
+# Chips per host by TPU generation (v4/v5p have 4 chips per host; v5e/v6e
+# hosts in the common 8-chip topology expose 8; override with TPU_CHIPS).
+_CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5p": 4, "v5e": 8,
+                   "v5litepod": 8, "v6e": 8}
+
+
+def _detect_tpu_chips() -> int:
+    """Detect local TPU chips from the environment WITHOUT initializing any
+    JAX backend (backend init grabs the accelerator and can block — the
+    runtime must never do that as a side effect of ``init()``)."""
+    global _tpu_chips_cache
+    if _tpu_chips_cache is not None:
+        return _tpu_chips_cache
+    import os
+
+    if os.environ.get("TPU_CHIPS"):
+        _tpu_chips_cache = int(os.environ["TPU_CHIPS"])
+        return _tpu_chips_cache
+    topo = os.environ.get("TPU_TOPOLOGY", "")  # e.g. "2x2x1" (chips)
+    if topo:
+        try:
+            n = 1
+            for part in topo.lower().split("x"):
+                n *= int(part)
+            hosts = len(os.environ.get("TPU_WORKER_HOSTNAMES",
+                                       "localhost").split(","))
+            _tpu_chips_cache = max(1, n // max(1, hosts))
+            return _tpu_chips_cache
+        except ValueError:
+            pass
+    acc = os.environ.get("TPU_ACCELERATOR_TYPE", "")  # e.g. "v5p-64"
+    if acc:
+        gen = acc.split("-")[0].lower()
+        _tpu_chips_cache = _CHIPS_PER_HOST.get(gen, 4)
+        return _tpu_chips_cache
+    # Single-chip tunneled dev environments (axon) expose the generation.
+    if os.environ.get("PALLAS_AXON_TPU_GEN"):
+        _tpu_chips_cache = 1
+        return _tpu_chips_cache
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if "tpu" in platforms:
+        _tpu_chips_cache = 4
+        return _tpu_chips_cache
+    _tpu_chips_cache = 0
+    return 0
+
+
+def detect_tpu_topology() -> Optional[TpuTopology]:
+    import os
+
+    acc = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    if not acc:
+        from .config import get_config
+
+        acc = get_config().tpu_accelerator_type
+    if not acc and not _detect_tpu_chips():
+        return None
+    hostname = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return TpuTopology(
+        accelerator_type=acc or "unknown",
+        slice_name=os.environ.get("TPU_NAME", ""),
+        worker_index=int(os.environ.get("TPU_WORKER_ID", "0") or 0),
+        num_workers=len(hostname.split(",")) if hostname else 1,
+        chips_per_host=_detect_tpu_chips() or 4,
+    )

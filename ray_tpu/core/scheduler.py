@@ -1,0 +1,189 @@
+"""Cluster resource scheduling: node selection policies + bundle placement.
+
+Analog of the reference's two-level scheduler
+(src/ray/raylet/scheduling/cluster_resource_scheduler.h:44
+``GetBestSchedulableNode``, policies under scheduling/policy/ — hybrid
+:contentReference hybrid_scheduling_policy.h:50, spread, node-affinity,
+bundle PACK/SPREAD/STRICT_* bundle_scheduling_policy.cc). Queueing/dispatch
+lives with each node's worker pool (head.py); this module is the pure
+placement math, unit-testable without any processes (mirroring
+cluster_resource_scheduler_test.cc).
+
+TPU-first addition: STRICT_PACK placement of TPU bundles is ICI-topology
+aware — bundles requesting TPU chips prefer hosts of one slice, contiguous
+by worker_index, so that the gang they host forms a connected ICI sub-torus.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Sequence
+
+from .config import get_config
+from .resources import NodeResources, ResourceSet, TPU
+from .task_spec import PlacementGroupSpec, SchedulingStrategy
+
+
+class ClusterResourceScheduler:
+    """Maintains the resource view of every node and picks placements."""
+
+    def __init__(self):
+        self.nodes: Dict[int, NodeResources] = {}
+        self._draining: set = set()
+        self._rng = random.Random(0)
+
+    def add_node(self, idx: int, res: NodeResources):
+        self.nodes[idx] = res
+
+    def remove_node(self, idx: int):
+        self.nodes.pop(idx, None)
+        self._draining.discard(idx)
+
+    def drain_node(self, idx: int):
+        self._draining.add(idx)
+
+    def schedulable_nodes(self) -> List[int]:
+        return [i for i in self.nodes if i not in self._draining]
+
+    # -- single-task placement -------------------------------------------
+
+    def best_node(self, request: ResourceSet, strategy: SchedulingStrategy,
+                  local_idx: int = 0) -> Optional[int]:
+        """Pick a node for one resource request; None if infeasible now.
+
+        DEFAULT uses the hybrid policy: prefer the local node while its
+        utilization is below ``scheduler_spread_threshold``, else pick from
+        the top-k least-utilized feasible nodes at random (reference
+        hybrid_scheduling_policy.h:50).
+        """
+        if strategy.kind == "NODE_AFFINITY":
+            idx = int(strategy.node_id)
+            node = self.nodes.get(idx)
+            if node is None:
+                return None if not strategy.soft else self._hybrid(request, local_idx)
+            if node.is_available(request):
+                return idx
+            if strategy.soft:
+                return self._hybrid(request, local_idx)
+            return idx if node.is_feasible(request) else None
+        if strategy.kind == "SPREAD":
+            return self._spread(request)
+        return self._hybrid(request, local_idx)
+
+    def _feasible_available(self, request: ResourceSet) -> List[int]:
+        return [i for i in self.schedulable_nodes()
+                if self.nodes[i].is_available(request)]
+
+    def _hybrid(self, request: ResourceSet, local_idx: int) -> Optional[int]:
+        cfg = get_config()
+        avail = self._feasible_available(request)
+        if not avail:
+            return None
+        local = self.nodes.get(local_idx)
+        if (local_idx in avail and local is not None
+                and local.utilization() < cfg.scheduler_spread_threshold):
+            return local_idx
+        avail.sort(key=lambda i: (self.nodes[i].utilization(), i))
+        k = max(1, int(len(avail) * cfg.scheduler_top_k_fraction))
+        return self._rng.choice(avail[:k])
+
+    def _spread(self, request: ResourceSet) -> Optional[int]:
+        avail = self._feasible_available(request)
+        if not avail:
+            return None
+        return min(avail, key=lambda i: (self.nodes[i].utilization(), i))
+
+    def is_feasible_anywhere(self, request: ResourceSet) -> bool:
+        return any(self.nodes[i].is_feasible(request)
+                   for i in self.schedulable_nodes())
+
+    # -- placement-group bundle placement --------------------------------
+
+    def place_bundles(self, spec: PlacementGroupSpec) -> Optional[List[int]]:
+        """Return node index per bundle, or None if unplaceable now.
+
+        Works against *available* resources; caller commits reservations.
+        """
+        reqs = [ResourceSet(b.resources) for b in spec.bundles]
+        scratch = {i: self.nodes[i].available for i in self.schedulable_nodes()}
+
+        def try_fit(order: Sequence[int], node_order: List[int],
+                    one_per_node: bool) -> Optional[List[int]]:
+            placement: List[Optional[int]] = [None] * len(reqs)
+            avail = dict(scratch)
+            used_nodes = set()
+            for bi in order:
+                placed = False
+                for ni in node_order:
+                    if one_per_node and ni in used_nodes:
+                        continue
+                    if avail[ni].covers(reqs[bi]):
+                        avail[ni] = avail[ni].subtract(reqs[bi])
+                        placement[bi] = ni
+                        used_nodes.add(ni)
+                        placed = True
+                        break
+                if not placed:
+                    return None
+            return placement  # type: ignore[return-value]
+
+        # Largest bundles first for better packing.
+        order = sorted(range(len(reqs)),
+                       key=lambda i: -sum(reqs[i].to_dict().values()))
+        nodes = list(scratch.keys())
+
+        if spec.strategy == "STRICT_PACK":
+            # All bundles on one node; for TPU bundles prefer the node whose
+            # topology matches (slice-local).
+            for ni in self._tpu_aware_order(nodes, reqs):
+                avail = scratch[ni]
+                ok = True
+                for bi in order:
+                    if not avail.covers(reqs[bi]):
+                        ok = False
+                        break
+                    avail = avail.subtract(reqs[bi])
+                if ok:
+                    return [ni] * len(reqs)
+            return None
+        if spec.strategy == "STRICT_SPREAD":
+            node_order = self._tpu_aware_order(nodes, reqs)
+            return try_fit(order, node_order, one_per_node=True)
+        if spec.strategy == "SPREAD":
+            node_order = sorted(nodes, key=lambda i: self.nodes[i].utilization())
+            out = try_fit(order, node_order, one_per_node=True)
+            if out is not None:
+                return out
+            # Best-effort: least-loaded node per bundle, updating as we go.
+            placement: List[Optional[int]] = [None] * len(reqs)
+            avail = dict(scratch)
+            for bi in order:
+                fitting = [ni for ni in nodes if avail[ni].covers(reqs[bi])]
+                if not fitting:
+                    return None
+                ni = max(fitting,
+                         key=lambda n: sum(avail[n].to_dict().values()))
+                avail[ni] = avail[ni].subtract(reqs[bi])
+                placement[bi] = ni
+            return placement  # type: ignore[return-value]
+        # PACK: minimize node count — fill nodes greedily, most-available first.
+        node_order = self._tpu_aware_order(nodes, reqs)
+        return try_fit(order, node_order, one_per_node=False)
+
+    def _tpu_aware_order(self, nodes: List[int], reqs: List[ResourceSet]
+                         ) -> List[int]:
+        """Order candidate nodes for packing. If the bundles want TPU chips,
+        group hosts by slice and order by worker_index so a multi-host gang
+        lands on a contiguous ICI sub-torus; otherwise most-available-first."""
+        wants_tpu = any(r.get(TPU) > 0 for r in reqs)
+        if not wants_tpu:
+            return sorted(nodes, key=lambda i: -sum(
+                self.nodes[i].available.to_dict().values()))
+
+        def key(i):
+            t = self.nodes[i].tpu
+            if t is None:
+                return (1, "", 0)
+            return (0, t.slice_name, t.worker_index)
+
+        return sorted(nodes, key=key)

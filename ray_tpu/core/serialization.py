@@ -1,0 +1,72 @@
+"""Serialization: pickle protocol 5 with out-of-band buffers.
+
+Analog of python/ray/_private/serialization.py in the reference (pickle5 +
+zero-copy buffer support + custom reducers). We rely on stock pickle (3.12)
+plus cloudpickle for closures/lambdas in function descriptors. ObjectRefs
+embedded in values are collected during serialization so the borrower
+protocol can register them with their owners.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, List, Tuple
+
+try:
+    import cloudpickle
+except ImportError:  # pragma: no cover
+    from ray_tpu.utils import _cloudpickle_stub as cloudpickle  # type: ignore
+
+
+class SerializedValue:
+    """A value serialized into frames: frame 0 is the pickle stream, frames
+    1..n are out-of-band buffers (e.g. numpy array payloads)."""
+
+    __slots__ = ("frames", "contained_refs")
+
+    def __init__(self, frames: List[bytes], contained_refs: List[Any]):
+        self.frames = frames
+        self.contained_refs = contained_refs
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(len(f) for f in self.frames)
+
+
+def serialize(value: Any) -> SerializedValue:
+    buffers: List[pickle.PickleBuffer] = []
+    contained_refs: List[Any] = []
+
+    from .object_ref import ObjectRef
+
+    class _Pickler(cloudpickle.CloudPickler):
+        def persistent_id(self, obj):
+            return None
+
+        def reducer_override(self, obj):
+            if isinstance(obj, ObjectRef):
+                contained_refs.append(obj)
+                return (ObjectRef._deserialize, (obj.id.binary(), obj.owner))
+            return NotImplemented
+
+    sio = io.BytesIO()
+    p = _Pickler(sio, protocol=5, buffer_callback=buffers.append)
+    p.dump(value)
+    frames = [sio.getvalue()]
+    for b in buffers:
+        frames.append(b.raw())
+    return SerializedValue(frames, contained_refs)
+
+
+def deserialize(frames: List) -> Any:
+    return pickle.loads(frames[0], buffers=frames[1:])
+
+
+def dumps(value: Any) -> bytes:
+    """One-shot in-band serialization (for control messages)."""
+    return cloudpickle.dumps(value, protocol=5)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)

@@ -1,0 +1,46 @@
+"""Build the native components into ray_tpu/native/lib*.so.
+
+Invoked lazily at import time (ray_tpu.core.object_store) if the shared
+library is missing or older than its sources, and by `python -m
+ray_tpu.native.build` explicitly. Uses g++ directly — the only dependencies
+are pthreads and librt.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+TARGETS = {
+    "libshm_store.so": ["shm_store.cc"],
+}
+
+CXXFLAGS = ["-O2", "-fPIC", "-shared", "-std=c++17", "-Wall"]
+LDFLAGS = ["-lpthread", "-lrt"]
+
+
+def build(force: bool = False) -> None:
+    for lib, sources in TARGETS.items():
+        out = os.path.join(_DIR, lib)
+        srcs = [os.path.join(_DIR, s) for s in sources]
+        if (
+            not force
+            and os.path.exists(out)
+            and all(os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs)
+        ):
+            continue
+        cmd = ["g++", *CXXFLAGS, "-o", out, *srcs, *LDFLAGS]
+        subprocess.run(cmd, check=True, cwd=_DIR)
+
+
+def lib_path(name: str) -> str:
+    build()
+    return os.path.join(_DIR, name)
+
+
+if __name__ == "__main__":
+    build(force="--force" in sys.argv)
+    print("native libs built")

@@ -1,0 +1,50 @@
+"""Test configuration.
+
+JAX runs on a virtual 8-device CPU mesh in all tests (TPU hardware is not
+assumed), mirroring the reference's strategy of testing distributed
+semantics in one process (SURVEY.md §4). The env vars must be set before any
+JAX backend initializes.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8").strip()
+# Workers inherit this too; keep them off the TPU and quiet.
+os.environ.setdefault("TPU_CHIPS", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start():
+    """Fresh single-node runtime per test (4 CPUs)."""
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """A Cluster handle with a head node; tests add nodes as needed."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2, "num_tpus": 0})
+    yield cluster
+    cluster.shutdown()
+
+
+@pytest.fixture(scope="module")
+def shared_ray():
+    """Module-scoped runtime for cheap API tests."""
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=4, num_tpus=0, ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()

@@ -1,0 +1,259 @@
+"""Integration tests for the core task/actor/object API (real worker
+processes; analog of python/ray/tests/test_basic*.py in the reference)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    info = ray_tpu.init(num_cpus=4, num_tpus=0, ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def echo(x):
+    return x
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+class TestTasks:
+    def test_basic(self, rt):
+        assert ray_tpu.get(add.remote(1, 2), timeout=60) == 3
+
+    def test_kwargs(self, rt):
+        assert ray_tpu.get(add.remote(1, b=5), timeout=60) == 6
+
+    def test_many_parallel(self, rt):
+        refs = [echo.remote(i) for i in range(100)]
+        assert ray_tpu.get(refs, timeout=60) == list(range(100))
+
+    def test_large_result_via_shm(self, rt):
+        @ray_tpu.remote
+        def big():
+            return np.ones(1_000_000, dtype=np.float32)
+
+        out = ray_tpu.get(big.remote(), timeout=60)
+        assert out.shape == (1_000_000,) and out[0] == 1.0
+
+    def test_large_arg_by_ref(self, rt):
+        arr = np.arange(500_000, dtype=np.float64)
+        ref = ray_tpu.put(arr)
+        total = ray_tpu.get(
+            ray_tpu.remote(lambda x: float(np.sum(x))).remote(ref),
+            timeout=60)
+        assert total == float(arr.sum())
+
+    def test_multiple_returns(self, rt):
+        @ray_tpu.remote(num_returns=2)
+        def two():
+            return 1, 2
+
+        a, b = two.remote()
+        assert ray_tpu.get(a, timeout=60) == 1
+        assert ray_tpu.get(b, timeout=60) == 2
+
+    def test_error_propagation(self, rt):
+        @ray_tpu.remote(max_retries=0)
+        def boom():
+            raise ValueError("expected-failure")
+
+        with pytest.raises(ray_tpu.RayTaskError) as ei:
+            ray_tpu.get(boom.remote(), timeout=60)
+        assert "expected-failure" in str(ei.value)
+
+    def test_nested_submission(self, rt):
+        @ray_tpu.remote
+        def outer(n):
+            return sum(ray_tpu.get([echo.remote(i) for i in range(n)],
+                                   timeout=60))
+
+        assert ray_tpu.get(outer.remote(5), timeout=120) == 10
+
+    def test_ref_in_datastructure(self, rt):
+        ref = ray_tpu.put(41)
+
+        @ray_tpu.remote
+        def unwrap(d):
+            return ray_tpu.get(d["ref"], timeout=60) + 1
+
+        assert ray_tpu.get(unwrap.remote({"ref": ref}), timeout=60) == 42
+
+    def test_wait(self, rt):
+        @ray_tpu.remote
+        def slow(t):
+            time.sleep(t)
+            return t
+
+        fast, stuck = slow.remote(0.01), slow.remote(10)
+        ready, rest = ray_tpu.wait([fast, stuck], num_returns=1, timeout=30)
+        assert ready == [fast] and rest == [stuck]
+        ray_tpu.cancel(stuck, force=True)
+
+    def test_get_timeout(self, rt):
+        @ray_tpu.remote
+        def hang():
+            time.sleep(30)
+
+        ref = hang.remote()
+        with pytest.raises(ray_tpu.GetTimeoutError):
+            ray_tpu.get(ref, timeout=0.2)
+        ray_tpu.cancel(ref, force=True)
+
+    def test_options_override(self, rt):
+        f = echo.options(name="renamed")
+        assert ray_tpu.get(f.remote("v"), timeout=60) == "v"
+
+    def test_direct_call_rejected(self, rt):
+        with pytest.raises(TypeError):
+            echo(1)
+
+
+class TestActors:
+    def test_counter(self, rt):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.v = 0
+
+            def inc(self):
+                self.v += 1
+                return self.v
+
+        c = Counter.remote()
+        assert ray_tpu.get([c.inc.remote() for _ in range(5)],
+                           timeout=60) == [1, 2, 3, 4, 5]
+
+    def test_ordering(self, rt):
+        @ray_tpu.remote
+        class Log:
+            def __init__(self):
+                self.items = []
+
+            def append(self, x):
+                self.items.append(x)
+
+            def get(self):
+                return self.items
+
+        log = Log.remote()
+        for i in range(20):
+            log.append.remote(i)
+        assert ray_tpu.get(log.get.remote(), timeout=60) == list(range(20))
+
+    def test_actor_error(self, rt):
+        @ray_tpu.remote
+        class Bad:
+            def fail(self):
+                raise RuntimeError("actor-method-error")
+
+            def ok(self):
+                return "fine"
+
+        b = Bad.remote()
+        with pytest.raises(ray_tpu.RayTaskError):
+            ray_tpu.get(b.fail.remote(), timeout=60)
+        # actor survives method errors
+        assert ray_tpu.get(b.ok.remote(), timeout=60) == "fine"
+
+    def test_constructor_error(self, rt):
+        @ray_tpu.remote
+        class Broken:
+            def __init__(self):
+                raise ValueError("ctor-fail")
+
+            def m(self):
+                return 1
+
+        h = Broken.remote()
+        with pytest.raises((ray_tpu.ActorDiedError, ray_tpu.RayTaskError)):
+            ray_tpu.get(h.m.remote(), timeout=60)
+
+    def test_named_actor(self, rt):
+        @ray_tpu.remote
+        class Registry:
+            def ping(self):
+                return "pong"
+
+        Registry.options(name="reg1").remote()
+        time.sleep(0.5)
+        h = ray_tpu.get_actor("reg1")
+        assert ray_tpu.get(h.ping.remote(), timeout=60) == "pong"
+
+    def test_handle_passing(self, rt):
+        @ray_tpu.remote
+        class Store:
+            def __init__(self):
+                self.v = None
+
+            def set(self, v):
+                self.v = v
+
+            def get(self):
+                return self.v
+
+        @ray_tpu.remote
+        def writer(handle, v):
+            ray_tpu.get(handle.set.remote(v), timeout=60)
+            return True
+
+        s = Store.remote()
+        assert ray_tpu.get(writer.remote(s, 123), timeout=120)
+        assert ray_tpu.get(s.get.remote(), timeout=60) == 123
+
+    def test_async_actor(self, rt):
+        @ray_tpu.remote
+        class AsyncActor:
+            async def work(self, x):
+                import asyncio
+
+                await asyncio.sleep(0.01)
+                return x * 2
+
+        a = AsyncActor.remote()
+        assert ray_tpu.get(a.work.remote(21), timeout=60) == 42
+
+    def test_kill(self, rt):
+        @ray_tpu.remote
+        class Victim:
+            def ping(self):
+                return "pong"
+
+        v = Victim.remote()
+        assert ray_tpu.get(v.ping.remote(), timeout=60) == "pong"
+        ray_tpu.kill(v)
+        with pytest.raises((ray_tpu.ActorDiedError,
+                            ray_tpu.ActorUnavailableError)):
+            ray_tpu.get(v.ping.remote(), timeout=60)
+
+
+class TestObjects:
+    def test_put_get_roundtrip_types(self, rt):
+        for val in [1, "s", {"a": [1, 2]}, None, (1, 2),
+                    np.arange(10)]:
+            out = ray_tpu.get(ray_tpu.put(val), timeout=60)
+            if isinstance(val, np.ndarray):
+                assert np.array_equal(out, val)
+            else:
+                assert out == val
+
+    def test_double_get_same_value(self, rt):
+        ref = ray_tpu.put([1, 2, 3])
+        assert ray_tpu.get(ref, timeout=60) == ray_tpu.get(ref, timeout=60)
+
+    def test_put_of_ref_rejected(self, rt):
+        with pytest.raises(TypeError):
+            ray_tpu.put(ray_tpu.put(1))
+
+    def test_cluster_resources(self, rt):
+        res = ray_tpu.cluster_resources()
+        assert res["CPU"] == 4.0

@@ -1,0 +1,100 @@
+"""Unit tests for IDs, resource model, and config (no processes)."""
+
+import pytest
+
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import (ActorID, JobID, NodeID, ObjectID,
+                              PlacementGroupID, TaskID)
+from ray_tpu.core.resources import (NodeResources, ResourceSet, TpuTopology)
+
+
+class TestIds:
+    def test_sizes_and_roundtrip(self):
+        j = JobID.from_int(7)
+        assert j.to_int() == 7
+        a = ActorID.of(j)
+        assert a.job_id() == j
+        t = TaskID.for_actor_task(a)
+        assert len(t.binary()) == TaskID.SIZE
+        o = ObjectID.for_return(t, 1)
+        assert o.task_id() == t
+        assert o.index() == 1
+        assert not o.is_put()
+        p = ObjectID.for_put(t, 3)
+        assert p.is_put() and p.index() == 3
+
+    def test_hex_roundtrip(self):
+        n = NodeID.from_random()
+        assert NodeID.from_hex(n.hex()) == n
+
+    def test_nil(self):
+        assert TaskID.nil().is_nil()
+        assert not TaskID.for_normal_task(JobID.from_int(1)).is_nil()
+
+    def test_uniqueness(self):
+        ids = {ObjectID.from_random() for _ in range(1000)}
+        assert len(ids) == 1000
+
+    def test_pickle(self):
+        import pickle
+
+        t = TaskID.for_normal_task(JobID.from_int(1))
+        assert pickle.loads(pickle.dumps(t)) == t
+
+
+class TestResourceSet:
+    def test_fixed_point_fractions(self):
+        rs = ResourceSet({"CPU": 0.0001})
+        assert rs.get("CPU") == 0.0001
+        total = ResourceSet({"CPU": 1})
+        acc = total
+        for _ in range(10000):
+            acc = acc.subtract(rs)
+        assert acc.get("CPU") == 0
+
+    def test_covers_subtract_add(self):
+        a = ResourceSet({"CPU": 4, "TPU": 8})
+        b = ResourceSet({"CPU": 2, "TPU": 8})
+        assert a.covers(b)
+        assert not b.covers(a)
+        c = a.subtract(b)
+        assert c.get("CPU") == 2 and c.get("TPU") == 0
+        assert c.add(b) == a
+
+    def test_negative_raises(self):
+        with pytest.raises(ValueError):
+            ResourceSet({"CPU": 1}).subtract(ResourceSet({"CPU": 2}))
+        with pytest.raises(ValueError):
+            ResourceSet({"CPU": -1})
+
+    def test_node_resources_accounting(self):
+        nr = NodeResources(total=ResourceSet({"CPU": 4}),
+                           available=ResourceSet({"CPU": 4}))
+        req = ResourceSet({"CPU": 3})
+        assert nr.is_available(req)
+        nr.allocate(req)
+        assert not nr.is_available(req)
+        assert nr.utilization() == 0.75
+        nr.release(req)
+        assert nr.is_available(req)
+        with pytest.raises(ValueError):
+            nr.release(ResourceSet({"CPU": 1}))
+
+    def test_tpu_topology(self):
+        t = TpuTopology(accelerator_type="v5p-64", worker_index=3,
+                        num_workers=8, chips_per_host=4)
+        assert t.generation == "v5p"
+
+
+class TestConfig:
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_MAX_INLINE_OBJECT_SIZE", "12345")
+        cfg = Config()
+        assert cfg.max_inline_object_size == 12345
+
+    def test_apply_overrides(self):
+        cfg = Config()
+        cfg.apply_overrides({"scheduler_spread_threshold": 0.9})
+        assert cfg.scheduler_spread_threshold == 0.9
+        with pytest.raises(ValueError):
+            cfg.apply_overrides({"bogus_knob": 1})

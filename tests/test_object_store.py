@@ -1,0 +1,114 @@
+"""Unit tests for the native shared-memory object store (plasma analog)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import (ObjectExistsError,
+                                       ObjectStoreFullError, ShmObjectStore)
+
+
+@pytest.fixture
+def store():
+    s = ShmObjectStore(f"rtpu_test_{ObjectID.from_random().hex()[:8]}",
+                       32 * 1024 * 1024, create=True)
+    yield s
+    s.close()
+
+
+def test_create_seal_get_delete(store):
+    oid = ObjectID.from_random()
+    buf = store.create(oid, 5)
+    buf[:] = b"hello"
+    assert not store.contains(oid)  # not sealed yet
+    store.seal(oid)
+    assert store.contains(oid)
+    data, meta = store.get(oid)
+    assert bytes(data) == b"hello" and len(meta) == 0
+    del data, meta
+    store.release(oid)
+    assert store.delete(oid)
+    assert store.get(oid) is None
+
+
+def test_duplicate_create_rejected(store):
+    oid = ObjectID.from_random()
+    store.create(oid, 4)
+    with pytest.raises(ObjectExistsError):
+        store.create(oid, 4)
+
+
+def test_pinned_object_not_deletable(store):
+    oid = ObjectID.from_random()
+    store.create(oid, 4)
+    store.seal(oid)
+    d, m = store.get(oid)
+    del d, m
+    assert not store.delete(oid)  # pinned
+    store.release(oid)
+    assert store.delete(oid)
+
+
+def test_multi_client_zero_copy(store):
+    oid = ObjectID.from_random()
+    arr = np.arange(100_000, dtype=np.int64)
+    sv = serialization.serialize(arr)
+    store.put_serialized(oid, sv.frames)
+
+    client = ShmObjectStore(store.name)  # attach as another client
+    try:
+        frames = client.get_frames(oid)
+        out = serialization.deserialize(frames)
+        assert np.array_equal(out, arr)
+        del out, frames
+        client.release(oid)
+    finally:
+        client.close()
+
+
+def test_alloc_free_coalescing(store):
+    """Fill, free, refill — fragmentation must not leak arena space."""
+    ids = []
+    for _ in range(20):
+        oid = ObjectID.from_random()
+        store.put_serialized(oid, [b"x" * 1_000_000])
+        ids.append(oid)
+    used = store.bytes_in_use()
+    for oid in ids:
+        assert store.delete(oid)
+    assert store.bytes_in_use() == 0
+    big = ObjectID.from_random()
+    store.put_serialized(big, [b"y" * (20 * 1_000_000)])
+    assert store.bytes_in_use() >= 20 * 1_000_000
+    assert used > 0
+
+
+def test_eviction_frees_lru(store):
+    ids = []
+    for _ in range(10):
+        oid = ObjectID.from_random()
+        store.put_serialized(oid, [b"x" * 2_000_000])
+        ids.append(oid)
+    evicted = store.evict(6_000_000)
+    assert len(evicted) >= 3
+    # oldest first
+    assert evicted[0] == ids[0]
+
+
+def test_store_full_raises(store):
+    oid = ObjectID.from_random()
+    with pytest.raises(ObjectStoreFullError):
+        store.create(oid, 64 * 1024 * 1024)
+
+
+def test_metadata_roundtrip(store):
+    oid = ObjectID.from_random()
+    buf = store.create(oid, 3, 2)
+    buf[:3] = b"abc"
+    buf[3:] = b"mm"
+    store.seal(oid)
+    data, meta = store.get(oid)
+    assert bytes(data) == b"abc" and bytes(meta) == b"mm"
+    del data, meta
+    store.release(oid)

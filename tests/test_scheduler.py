@@ -1,0 +1,131 @@
+"""Unit tests for cluster scheduling policies and bundle placement — pure
+in-memory, no processes (mirrors the reference's
+cluster_resource_scheduler_test.cc / bundle policy tests)."""
+
+from ray_tpu.core.ids import JobID, PlacementGroupID
+from ray_tpu.core.resources import NodeResources, ResourceSet, TpuTopology
+from ray_tpu.core.scheduler import ClusterResourceScheduler
+from ray_tpu.core.task_spec import (Bundle, PlacementGroupSpec,
+                                    SchedulingStrategy)
+
+
+def make_node(cpu=4, tpu=0, tpu_topo=None):
+    rs = ResourceSet({"CPU": cpu, **({"TPU": tpu} if tpu else {})})
+    return NodeResources(total=rs, available=rs, tpu=tpu_topo)
+
+
+def make_sched(n_nodes=3, cpu=4):
+    s = ClusterResourceScheduler()
+    for i in range(n_nodes):
+        s.add_node(i, make_node(cpu))
+    return s
+
+
+def pg_spec(bundles, strategy):
+    return PlacementGroupSpec(
+        pg_id=PlacementGroupID.of(JobID.from_int(1)),
+        bundles=[Bundle(resources=b) for b in bundles], strategy=strategy)
+
+
+class TestBestNode:
+    def test_default_prefers_local_when_underutilized(self):
+        s = make_sched()
+        assert s.best_node(ResourceSet({"CPU": 1}), SchedulingStrategy(),
+                           local_idx=0) == 0
+
+    def test_default_spills_when_local_busy(self):
+        s = make_sched()
+        s.nodes[0].allocate(ResourceSet({"CPU": 3}))  # 75% util
+        picked = s.best_node(ResourceSet({"CPU": 1}), SchedulingStrategy(),
+                             local_idx=0)
+        assert picked in (1, 2)
+
+    def test_infeasible_returns_none(self):
+        s = make_sched()
+        assert s.best_node(ResourceSet({"CPU": 100}),
+                           SchedulingStrategy()) is None
+
+    def test_spread_picks_least_utilized(self):
+        s = make_sched()
+        s.nodes[0].allocate(ResourceSet({"CPU": 2}))
+        s.nodes[1].allocate(ResourceSet({"CPU": 1}))
+        assert s.best_node(ResourceSet({"CPU": 1}),
+                           SchedulingStrategy(kind="SPREAD")) == 2
+
+    def test_node_affinity_hard_and_soft(self):
+        s = make_sched()
+        st = SchedulingStrategy(kind="NODE_AFFINITY", node_id="1")
+        assert s.best_node(ResourceSet({"CPU": 1}), st) == 1
+        s.nodes[1].allocate(ResourceSet({"CPU": 4}))
+        # busy-but-feasible: hard affinity still targets the node (queues)
+        assert s.best_node(ResourceSet({"CPU": 1}), st) == 1
+        # infeasible on the target node: hard fails, soft falls back
+        assert s.best_node(ResourceSet({"CPU": 100}), st) is None
+        st_soft = SchedulingStrategy(kind="NODE_AFFINITY", node_id="1",
+                                     soft=True)
+        assert s.best_node(ResourceSet({"CPU": 1}), st_soft) in (0, 2)
+
+    def test_drained_node_excluded(self):
+        s = make_sched()
+        s.drain_node(0)
+        st = SchedulingStrategy(kind="SPREAD")
+        for _ in range(5):
+            assert s.best_node(ResourceSet({"CPU": 1}), st) != 0
+
+    def test_tpu_resource(self):
+        s = ClusterResourceScheduler()
+        s.add_node(0, make_node(cpu=4))
+        s.add_node(1, make_node(cpu=4, tpu=4))
+        assert s.best_node(ResourceSet({"TPU": 2}),
+                           SchedulingStrategy()) == 1
+
+
+class TestBundlePlacement:
+    def test_strict_pack_one_node(self):
+        s = make_sched(3, cpu=4)
+        p = s.place_bundles(pg_spec([{"CPU": 2}, {"CPU": 2}], "STRICT_PACK"))
+        assert p is not None and len(set(p)) == 1
+
+    def test_strict_pack_infeasible(self):
+        s = make_sched(3, cpu=4)
+        assert s.place_bundles(
+            pg_spec([{"CPU": 3}, {"CPU": 3}], "STRICT_PACK")) is None
+
+    def test_strict_spread_distinct_nodes(self):
+        s = make_sched(3, cpu=4)
+        p = s.place_bundles(
+            pg_spec([{"CPU": 1}] * 3, "STRICT_SPREAD"))
+        assert p is not None and len(set(p)) == 3
+
+    def test_strict_spread_infeasible_when_too_few_nodes(self):
+        s = make_sched(2, cpu=4)
+        assert s.place_bundles(
+            pg_spec([{"CPU": 1}] * 3, "STRICT_SPREAD")) is None
+
+    def test_spread_falls_back_to_sharing(self):
+        s = make_sched(2, cpu=4)
+        p = s.place_bundles(pg_spec([{"CPU": 1}] * 3, "SPREAD"))
+        assert p is not None and len(set(p)) == 2
+
+    def test_pack_minimizes_nodes(self):
+        s = make_sched(3, cpu=4)
+        p = s.place_bundles(pg_spec([{"CPU": 1}] * 4, "PACK"))
+        assert p is not None and len(set(p)) == 1
+
+    def test_tpu_ici_contiguity(self):
+        """STRICT_SPREAD of TPU bundles lands on hosts of one slice ordered
+        by worker_index — a contiguous ICI sub-torus."""
+        s = ClusterResourceScheduler()
+        # two slices, interleaved insertion order
+        for i, (slc, wi) in enumerate([("b", 1), ("a", 0), ("b", 0),
+                                       ("a", 1)]):
+            s.add_node(i, make_node(
+                cpu=4, tpu=4,
+                tpu_topo=TpuTopology(accelerator_type="v5p-32",
+                                     slice_name=slc, worker_index=wi,
+                                     num_workers=2)))
+        p = s.place_bundles(pg_spec([{"TPU": 4}, {"TPU": 4}],
+                                    "STRICT_SPREAD"))
+        assert p is not None
+        slices = {s.nodes[i].tpu.slice_name for i in p}
+        assert slices == {"a"}  # both bundles on slice "a", hosts 0 and 1
