@@ -1,0 +1,87 @@
+"""Headline benchmark: flagship train-step throughput on the attached device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Runs the GPT-2-small-scale decoder's full jitted train step (fwd+bwd+adamw,
+bf16 compute) on whatever single device is attached (TPU via the axon tunnel
+in CI; CPU elsewhere), measures tokens/sec/chip, and reports MFU-relative
+progress: vs_baseline = achieved_MFU / 0.40, the north-star 40% MFU target
+from BASELINE.json (the reference has no TPU number to compare against —
+SURVEY.md §6).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+# bf16 peak FLOP/s per chip by TPU generation (public spec sheets).
+_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def _peak_flops() -> float:
+    import os
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    for key, val in _PEAK_FLOPS.items():
+        if gen.startswith(key):
+            return val
+    if jax.default_backend() == "cpu":
+        return 1e12  # nominal; CPU runs are smoke tests, not benchmarks
+    return 197e12
+
+
+def main():
+    from ray_tpu.models import (
+        gpt2_small_config,
+        init_train_state,
+        make_optimizer,
+        make_train_step,
+        tiny_config,
+    )
+
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:
+        cfg = tiny_config(max_seq_len=128)
+        batch_size, seq, steps = 8, 128, 5
+    else:
+        cfg = gpt2_small_config()
+        batch_size, seq, steps = 8, 1024, 10
+
+    tx = make_optimizer(3e-4)
+    state = init_train_state(jax.random.key(0), cfg, tx)
+    step = make_train_step(cfg, tx)
+
+    toks = jax.random.randint(jax.random.key(1), (batch_size, seq + 1), 0,
+                              cfg.vocab_size, dtype=jnp.int32)
+    batch = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+
+    # Warmup / compile.
+    state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch_size * seq * steps / dt
+    flops_per_token = cfg.flops_per_token(seq)
+    mfu = tokens_per_sec * flops_per_token / _peak_flops()
+
+    print(json.dumps({
+        "metric": "train_step_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

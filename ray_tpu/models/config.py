@@ -1,0 +1,111 @@
+"""Model configurations for the built-in transformer family.
+
+The reference ships no LLM definitions (its model zoo is RLlib's small
+policy nets, rllib/models/ — SURVEY.md §2.4); the flagship LLM family here
+serves the north-star workloads in BASELINE.json (GPT-2-small data-parallel,
+Llama-3-8B FSDP pretrain, Llama-3-8B serving).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """A Llama-3-style decoder-only transformer (RMSNorm, RoPE, GQA, SwiGLU)."""
+
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None  # None -> MHA
+    d_ff: int = 1408
+    max_seq_len: int = 2048
+    rope_theta: float = 500_000.0
+    rms_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16        # activation/compute dtype
+    param_dtype: jnp.dtype = jnp.float32   # master weights
+    tie_embeddings: bool = False
+    remat: bool = True                     # checkpoint each layer (HBM <-> FLOPs)
+    # "auto": ring attention iff mesh's sequence axis > 1, else pallas flash
+    # on TPU, else plain XLA attention.
+    attention_impl: str = "auto"
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @property
+    def num_params(self) -> int:
+        d, v, L = self.d_model, self.vocab_size, self.n_layers
+        hd, H, KV, ff = self.head_dim, self.n_heads, self.kv_heads, self.d_ff
+        per_layer = (d * H * hd + 2 * d * KV * hd + H * hd * d  # attn
+                     + 3 * d * ff                               # swiglu
+                     + 2 * d)                                   # norms
+        head = 0 if self.tie_embeddings else d * v
+        return v * d + L * per_layer + d + head
+
+    def flops_per_token(self, seq_len: Optional[int] = None) -> float:
+        """Approximate training FLOPs/token: 6*N + attention quadratic term."""
+        s = seq_len or self.max_seq_len
+        attn = 12 * self.n_layers * self.d_model * s  # fwd+bwd qk^T and av
+        return 6.0 * self.num_params + attn
+
+
+# ---- presets ---------------------------------------------------------------
+
+def tiny_config(**kw) -> TransformerConfig:
+    """Unit-test sized; runs in milliseconds on CPU."""
+    base = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                n_kv_heads=2, d_ff=128, max_seq_len=128,
+                dtype=jnp.float32, remat=False)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def gpt2_small_config(**kw) -> TransformerConfig:
+    """124M-class decoder (GPT-2-small scale, modern Llama-style blocks)."""
+    base = dict(vocab_size=50304, d_model=768, n_layers=12, n_heads=12,
+                n_kv_heads=12, d_ff=3072, max_seq_len=1024,
+                tie_embeddings=True)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def llama3_8b_config(**kw) -> TransformerConfig:
+    """Llama-3-8B geometry (the north-star pretrain target)."""
+    base = dict(vocab_size=128_256, d_model=4096, n_layers=32, n_heads=32,
+                n_kv_heads=8, d_ff=14336, max_seq_len=8192,
+                rope_theta=500_000.0)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def llama3_70b_config(**kw) -> TransformerConfig:
+    base = dict(vocab_size=128_256, d_model=8192, n_layers=80, n_heads=64,
+                n_kv_heads=8, d_ff=28672, max_seq_len=8192)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+PRESETS = {
+    "tiny": tiny_config,
+    "gpt2-small": gpt2_small_config,
+    "llama3-8b": llama3_8b_config,
+    "llama3-70b": llama3_70b_config,
+}
+
+
+def get_config(name: str, **kw) -> TransformerConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown model preset {name!r}; have {list(PRESETS)}")
+    return PRESETS[name](**kw)

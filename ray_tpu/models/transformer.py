@@ -1,0 +1,199 @@
+"""Llama-family decoder in functional JAX: pytree params, scan over layers.
+
+TPU-first design choices:
+  - Layer weights are *stacked* on a leading `layers` axis and the block is a
+    `lax.scan` body — one trace/compile of the block regardless of depth, and
+    a natural substrate for pipeline parallelism later.
+  - Every parameter and activation carries *logical* axis names; actual
+    sharding comes from `ray_tpu.parallel.sharding` rules, so the same model
+    runs DP, FSDP, TP, and ring-CP unchanged.
+  - Compute in bfloat16 on the MXU, master params float32, loss/softmax
+    accumulation float32.
+  - `jax.checkpoint` on the scanned block trades FLOPs for HBM (remat).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ray_tpu.models.config import TransformerConfig
+from ray_tpu.parallel.ring import reference_attention, ring_attention
+from ray_tpu.parallel.sharding import with_logical_constraint as _wlc
+
+Params = Dict[str, Any]
+
+
+# ---- parameter structure ---------------------------------------------------
+
+def param_logical_axes(cfg: TransformerConfig) -> Params:
+    """Same-structure pytree of logical axis tuples (for shardings)."""
+    lay = {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads", "qkv_dim"),
+        "wk": ("layers", "embed", "kv_heads", "qkv_dim"),
+        "wv": ("layers", "embed", "kv_heads", "qkv_dim"),
+        "wo": ("layers", "heads", "qkv_dim", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "w_gate": ("layers", "embed", "mlp"),
+        "w_up": ("layers", "embed", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+    }
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": lay,
+        "final_norm": ("embed",),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
+    d, v, L = cfg.d_model, cfg.vocab_size, cfg.n_layers
+    hd, H, KV, ff = cfg.head_dim, cfg.n_heads, cfg.kv_heads, cfg.d_ff
+    pd = cfg.param_dtype
+    k = iter(jax.random.split(rng, 16))
+
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(pd)
+
+    emb_scale = d ** -0.5
+    in_scale = d ** -0.5
+    out_scale = (2 * L) ** -0.5 * d ** -0.5  # depth-scaled residual outputs
+    params: Params = {
+        "embed": normal(next(k), (v, d), emb_scale),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), pd),
+            "wq": normal(next(k), (L, d, H, hd), in_scale),
+            "wk": normal(next(k), (L, d, KV, hd), in_scale),
+            "wv": normal(next(k), (L, d, KV, hd), in_scale),
+            "wo": normal(next(k), (L, H, hd, d), out_scale),
+            "mlp_norm": jnp.ones((L, d), pd),
+            "w_gate": normal(next(k), (L, d, ff), in_scale),
+            "w_up": normal(next(k), (L, d, ff), in_scale),
+            "w_down": normal(next(k), (L, ff, d), out_scale * (ff / d) ** 0.5),
+        },
+        "final_norm": jnp.ones((d,), pd),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = normal(next(k), (d, v), in_scale)
+    return params
+
+
+# ---- building blocks -------------------------------------------------------
+
+def rms_norm(x, gamma, eps):
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * gamma.astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    """Rotary embedding. x: [B, T, H, D]; positions: [T]."""
+    d_half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(0, d_half, dtype=jnp.float32) / d_half)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T,Dh]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _select_attention(cfg: TransformerConfig, mesh: Optional[Mesh]):
+    impl = cfg.attention_impl
+    if impl == "auto":
+        if mesh is not None and mesh.shape.get("sequence", 1) > 1:
+            impl = "ring"
+        elif jax.default_backend() not in ("cpu",):
+            impl = "pallas"
+        else:
+            impl = "xla"
+    return impl
+
+
+def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh],
+               positions):
+    impl = _select_attention(cfg, mesh)
+    if impl == "ring":
+        return ring_attention(q, k, v, mesh, causal=True)
+    if impl == "pallas":
+        from ray_tpu.ops import flash_attention  # lazy: pallas import cost
+        return flash_attention(q, k, v, causal=True)
+    return reference_attention(q, k, v, causal=True)
+
+
+# ---- forward ---------------------------------------------------------------
+
+def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
+            mesh: Optional[Mesh] = None) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, vocab] float32."""
+    B, T = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]  # [B, T, d]
+    x = _wlc(x, ("batch", "seq", "embed"), mesh=mesh)
+    positions = jnp.arange(T)
+
+    def block(x, lp):
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(cfg.dtype))
+        k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(cfg.dtype))
+        v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(cfg.dtype))
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        reps = cfg.n_heads // cfg.kv_heads
+        if reps > 1:  # GQA: expand kv heads to match q heads
+            k = jnp.repeat(k, reps, axis=2)
+            v = jnp.repeat(v, reps, axis=2)
+        q = _wlc(q, ("batch", "seq", "heads", None), mesh=mesh)
+        o = _attention(q, k, v, cfg, mesh, positions)
+        o = jnp.einsum("bthk,hkd->btd", o, lp["wo"].astype(cfg.dtype))
+        x = x + _wlc(o, ("batch", "seq", "embed"), mesh=mesh)
+
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(cfg.dtype))
+        up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(cfg.dtype))
+        ff = jax.nn.silu(gate) * up
+        ff = _wlc(ff, ("batch", "seq", "mlp"), mesh=mesh)
+        down = jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(cfg.dtype))
+        x = x + _wlc(down, ("batch", "seq", "embed"), mesh=mesh)
+        return x, None
+
+    body = block
+    if cfg.remat:
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.nothing_saveable)
+    x, _ = jax.lax.scan(lambda c, lp: body(c, lp), x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
+                        head.astype(jnp.float32))
+    return _wlc(logits, ("batch", "seq", "vocab"), mesh=mesh)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array],
+            cfg: TransformerConfig, mesh: Optional[Mesh] = None):
+    """Next-token cross entropy. batch: {"tokens": [B,T]} (targets shifted)
+    or {"inputs": [B,T], "targets": [B,T], optional "mask": [B,T]}."""
+    if "inputs" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+        mask = batch.get("mask")
+    else:
+        toks = batch["tokens"]
+        inputs, targets = toks[:, :-1], toks[:, 1:]
+        mask = None
+    logits = forward(params, inputs, cfg, mesh)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    if mask is not None:
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (nll * mask).sum() / denom
+    else:
+        loss = nll.mean()
+    return loss, {"loss": loss, "perplexity": jnp.exp(loss)}

@@ -1,0 +1,9 @@
+"""TPU kernels (Pallas) for the hot ops XLA doesn't fuse well enough.
+
+Runs in Pallas interpret mode on CPU so the whole stack stays testable on the
+virtual device mesh (SURVEY.md §4 strategy).
+"""
+
+from ray_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

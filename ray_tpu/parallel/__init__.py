@@ -1,0 +1,24 @@
+"""SPMD parallelism layer: meshes, logical shardings, ring collectives.
+
+TPU-native replacement for the reference's NCCL plumbing (SURVEY.md §2.3):
+within a slice, collectives are compiled by XLA over ICI; this package only
+names the axes, builds meshes, and provides the sharded-attention primitives.
+"""
+
+from ray_tpu.parallel.mesh import MESH_AXES, MeshSpec, make_mesh, single_device_mesh
+from ray_tpu.parallel.ring import reference_attention, ring_attention
+from ray_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    logical_sharding,
+    logical_to_spec,
+    shard_array,
+    tree_shardings,
+    with_logical_constraint,
+)
+
+__all__ = [
+    "MESH_AXES", "MeshSpec", "make_mesh", "single_device_mesh",
+    "DEFAULT_RULES", "logical_to_spec", "logical_sharding", "tree_shardings",
+    "with_logical_constraint", "shard_array",
+    "ring_attention", "reference_attention",
+]

@@ -1,0 +1,99 @@
+"""Device-mesh construction for SPMD programs.
+
+The reference scales tensor computation by wiring NCCL process groups between
+actors (ref: python/ray/util/collective/collective.py:120,
+python/ray/train/torch/config.py:70). On TPU the intra-slice network (ICI) is
+programmed by the XLA compiler, so the framework's job reduces to *naming* the
+parallelism axes and building a `jax.sharding.Mesh` whose layout maps them
+onto the hardware torus. Everything downstream (Train, models, ops) speaks in
+these axis names.
+
+Axes (superset of anything the reference supports; ref has DP only in-tree,
+TP/PP delegated to Alpa — SURVEY.md §2.3):
+    data      — pure data parallelism (params replicated)
+    fsdp      — data parallelism with sharded params/opt state (ZeRO-3)
+    tensor    — Megatron-style tensor parallelism (heads/mlp sharded)
+    sequence  — context parallelism (ring attention over ICI)
+    expert    — MoE expert parallelism
+    pipeline  — pipeline stages (shard_map + ppermute microbatching)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order: outermost (DCN-friendly, infrequent comm) first,
+# innermost (ICI-hot, per-layer comm) last — matches how contiguous device
+# order maps onto the torus so tensor/sequence collectives ride nearest
+# neighbours.
+MESH_AXES: Tuple[str, ...] = (
+    "data", "fsdp", "expert", "pipeline", "sequence", "tensor")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape; -1 in at most one axis means "fill the rest".
+
+    Example::
+
+        MeshSpec(fsdp=-1, tensor=4).build()   # on 32 chips -> (1,8,1,1,1,4)
+    """
+
+    data: int = 1
+    fsdp: int = -1
+    expert: int = 1
+    pipeline: int = 1
+    sequence: int = 1
+    tensor: int = 1
+
+    def sizes(self, n_devices: int) -> Tuple[int, ...]:
+        raw = [self.data, self.fsdp, self.expert, self.pipeline,
+               self.sequence, self.tensor]
+        fills = [i for i, v in enumerate(raw) if v == -1]
+        if len(fills) > 1:
+            raise ValueError("at most one mesh axis may be -1 (fill)")
+        fixed = math.prod(v for v in raw if v != -1)
+        if fills:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}")
+            raw[fills[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {raw} needs {fixed} devices, have {n_devices}")
+        return tuple(raw)
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        devices = list(devices if devices is not None else jax.devices())
+        shape = self.sizes(len(devices))
+        arr = np.asarray(devices).reshape(shape)
+        return Mesh(arr, MESH_AXES)
+
+
+def make_mesh(n_devices: Optional[int] = None, **axis_sizes) -> Mesh:
+    """Shorthand: ``make_mesh(fsdp=8)`` or ``make_mesh(8, tensor=2)``.
+
+    With all axes fixed (no -1) and fewer requested than available, the
+    leading devices are used — convenient for tests on a virtual mesh.
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    spec = MeshSpec(**axis_sizes) if axis_sizes else MeshSpec()
+    sizes = [spec.data, spec.fsdp, spec.expert, spec.pipeline, spec.sequence,
+             spec.tensor]
+    if -1 not in sizes:
+        want = math.prod(sizes)
+        if want <= len(devices):
+            devices = devices[:want]
+    return spec.build(devices)
+
+
+def single_device_mesh() -> Mesh:
+    return MeshSpec(fsdp=1).build(jax.devices()[:1])

@@ -1,0 +1,110 @@
+"""Logical-axis sharding rules (GSPMD partitioning).
+
+The reference has no analog — its tensor plane is NCCL DDP with replicated
+params (ref: python/ray/train/torch/train_loop_utils.py:245 wraps the model in
+DistributedDataParallel). Here parallelism is expressed by annotating every
+array with *logical* axis names and translating those to mesh axes through a
+rule table, then letting XLA insert the collectives (the GSPMD recipe).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis -> mesh axis (or tuple of mesh axes, or None = replicate).
+# Batch shards over every data-like axis; embed shards over fsdp (ZeRO-3);
+# heads/mlp/vocab shard over tensor (Megatron); seq over sequence (ring CP).
+DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
+    ("batch", ("data", "fsdp")),
+    ("seq", "sequence"),
+    ("embed", "fsdp"),
+    ("heads", "tensor"),
+    ("kv_heads", "tensor"),
+    ("qkv_dim", None),
+    ("mlp", "tensor"),
+    ("vocab", "tensor"),
+    ("experts", "expert"),
+    ("layers", None),
+    ("stages", "pipeline"),
+)
+
+LogicalAxes = Tuple[Optional[str], ...]
+
+
+def rules_to_dict(rules=None) -> dict:
+    return dict(rules if rules is not None else DEFAULT_RULES)
+
+
+def logical_to_spec(logical: Sequence[Optional[str]], rules=None) -> P:
+    """Translate logical axis names into a PartitionSpec via the rule table."""
+    table = rules_to_dict(rules)
+    out, used = [], set()
+    for name in logical:
+        mesh_ax = table.get(name) if name is not None else None
+        # A mesh axis may appear only once per spec; later duplicates replicate.
+        if mesh_ax is None:
+            out.append(None)
+        elif isinstance(mesh_ax, tuple):
+            fresh = tuple(a for a in mesh_ax if a not in used)
+            used.update(fresh)
+            out.append(fresh if fresh else None)
+        elif mesh_ax in used:
+            out.append(None)
+        else:
+            used.add(mesh_ax)
+            out.append(mesh_ax)
+    return P(*out)
+
+
+def logical_sharding(mesh: Mesh, logical: Sequence[Optional[str]],
+                     rules=None) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(logical, rules))
+
+
+def tree_shardings(mesh: Mesh, logical_tree: Any, rules=None) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda ax: logical_sharding(mesh, ax, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x),
+    )
+
+
+def with_logical_constraint(x, logical: Sequence[Optional[str]], rules=None,
+                            mesh: Optional[Mesh] = None):
+    """`lax.with_sharding_constraint` in logical-axis vocabulary.
+
+    No-op outside a mesh context so model code runs un-meshed (single chip,
+    unit tests) unchanged.
+    """
+    mesh = mesh or _current_mesh()
+    if mesh is None or mesh.empty:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, logical_sharding(mesh, logical, rules))
+
+
+def _current_mesh() -> Optional[Mesh]:
+    try:
+        m = jax.sharding.get_abstract_mesh()  # jax>=0.4.35
+        if m is not None and not m.empty:
+            # Abstract mesh from `jax.set_mesh`/use_mesh context.
+            return m
+    except Exception:
+        pass
+    try:
+        env_mesh = jax.interpreters.pxla.thread_resources.env.physical_mesh
+        if env_mesh is not None and not env_mesh.empty:
+            return env_mesh
+    except Exception:
+        pass
+    return None
+
+
+def shard_array(mesh: Mesh, x, logical, rules=None):
+    """Device-put `x` with the sharding derived from its logical axes."""
+    return jax.device_put(x, logical_sharding(mesh, logical, rules))

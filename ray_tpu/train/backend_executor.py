@@ -1,0 +1,89 @@
+"""BackendExecutor: drives the worker gang through a training run.
+
+Ref analog: train/_internal/backend_executor.py:47 (start :106,
+start_training :345) — spawns the WorkerGroup, runs the backend's rendezvous
+(JAX multi-host init instead of torch.distributed), installs per-rank
+sessions, and streams back reported results round by round.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.backend import BackendConfig, JaxConfig
+from ray_tpu.train.session import TrainContext
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+class TrainingWorkerError(RuntimeError):
+    """A worker failed mid-training; carries the underlying cause."""
+
+
+class BackendExecutor:
+    def __init__(self, backend_config: Optional[BackendConfig],
+                 num_workers: int,
+                 resources_per_worker: Dict[str, float],
+                 placement_strategy: str = "PACK"):
+        self._backend_config = backend_config or JaxConfig()
+        self._backend = self._backend_config.backend_cls()
+        self._num_workers = num_workers
+        self._resources = resources_per_worker
+        self._strategy = placement_strategy
+        self.worker_group: Optional[WorkerGroup] = None
+
+    def start(self):
+        self.worker_group = WorkerGroup(self._num_workers, self._resources,
+                                        self._strategy)
+        self._backend.on_start(self.worker_group, self._backend_config)
+
+    def start_training(self, train_fn: Callable, config: Dict[str, Any],
+                       checkpoint=None, dataset_shards=None,
+                       experiment_name: str = "", trial_id: str = ""):
+        assert self.worker_group is not None, "call start() first"
+        n = self._num_workers
+        refs = []
+        for rank, w in enumerate(self.worker_group.workers):
+            ctx = TrainContext(
+                world_rank=rank, world_size=n, local_rank=0,
+                local_world_size=1, node_rank=rank,
+                experiment_name=experiment_name, trial_id=trial_id,
+                coordinator_address=getattr(self._backend,
+                                            "coordinator_address", ""))
+            shard = None
+            if dataset_shards is not None:
+                shard = {name: shards[rank]
+                         for name, shards in dataset_shards.items()}
+            refs.append(w.init_session.remote(
+                train_fn, config, ctx, checkpoint, shard))
+        ray_tpu.get(refs)
+        self._backend.on_training_start(self.worker_group,
+                                        self._backend_config)
+        ray_tpu.get([w.start_training.remote()
+                     for w in self.worker_group.workers])
+
+    def next_results(self) -> Optional[List[Any]]:
+        """One round: the next result from every worker (lock-step, like the
+        reference's TrainingIterator). None once all workers are done."""
+        assert self.worker_group is not None
+        try:
+            results = ray_tpu.get([w.get_next.remote()
+                                   for w in self.worker_group.workers])
+        except Exception as e:  # worker raised or died
+            raise TrainingWorkerError(str(e)) from e
+        kinds = {kind for kind, _ in results}
+        if kinds == {"done"}:
+            return None
+        if "done" in kinds:
+            # Mixed finish (e.g. uneven loops): treat remaining reports as
+            # the last round and finish after.
+            return [payload for kind, payload in results
+                    if kind == "report"] or None
+        return [payload for _, payload in results]
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            self._backend.on_shutdown(self.worker_group,
+                                      self._backend_config)
+            self.worker_group.shutdown()
+            self.worker_group = None

@@ -1,0 +1,177 @@
+"""Checkpoints: dict/dir duality + top-K retention.
+
+Ref analogs: air/checkpoint.py (dict<->directory Checkpoint) and
+train/_internal/checkpoint_manager.py (top-K by score). JAX pytrees are
+stored as a flat .npz of leaves plus a pickled treedef, so checkpoints of
+sharded arrays round-trip through host memory without torch/pickle bloat.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_METADATA = "ckpt_meta.json"
+_PAYLOAD = "payload.pkl"
+_PYTREE_NPZ = "pytree_leaves.npz"
+_PYTREE_DEF = "pytree_def.pkl"
+
+
+class Checkpoint:
+    """Immutable handle on a checkpoint, backed by a dict or a directory."""
+
+    def __init__(self, *, _dict: Optional[Dict[str, Any]] = None,
+                 _path: Optional[str] = None):
+        self._dict = _dict
+        self._path = _path
+
+    # -- constructors --
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(_dict=dict(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(_path=str(path))
+
+    @classmethod
+    def from_pytree(cls, tree: Any, **extra) -> "Checkpoint":
+        """Store a JAX pytree (params/opt state) efficiently."""
+        import jax
+
+        leaves, treedef = jax.tree.flatten(jax.device_get(tree))
+        return cls(_dict={"__pytree_leaves__": leaves,
+                          "__pytree_def__": treedef, **extra})
+
+    # -- accessors --
+
+    def to_dict(self) -> Dict[str, Any]:
+        if self._dict is not None:
+            return dict(self._dict)
+        data = {}
+        payload = os.path.join(self._path, _PAYLOAD)
+        if os.path.exists(payload):
+            with open(payload, "rb") as f:
+                data.update(pickle.load(f))
+        npz = os.path.join(self._path, _PYTREE_NPZ)
+        if os.path.exists(npz):
+            with np.load(npz, allow_pickle=False) as z:
+                leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
+            with open(os.path.join(self._path, _PYTREE_DEF), "rb") as f:
+                data["__pytree_def__"] = pickle.load(f)
+            data["__pytree_leaves__"] = leaves
+        return data
+
+    def to_pytree(self) -> Tuple[Any, Dict[str, Any]]:
+        data = self.to_dict()
+        leaves = data.pop("__pytree_leaves__")
+        treedef = data.pop("__pytree_def__")
+        import jax
+
+        return jax.tree.unflatten(treedef, leaves), data
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None:
+            path = tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        if self._path is not None:
+            if os.path.abspath(self._path) != os.path.abspath(path):
+                shutil.copytree(self._path, path, dirs_exist_ok=True)
+            return path
+        data = dict(self._dict)
+        leaves = data.pop("__pytree_leaves__", None)
+        treedef = data.pop("__pytree_def__", None)
+        if leaves is not None:
+            np.savez(os.path.join(path, _PYTREE_NPZ),
+                     **{f"leaf_{i}": np.asarray(x)
+                        for i, x in enumerate(leaves)})
+            with open(os.path.join(path, _PYTREE_DEF), "wb") as f:
+                pickle.dump(treedef, f)
+        with open(os.path.join(path, _PAYLOAD), "wb") as f:
+            pickle.dump(data, f)
+        with open(os.path.join(path, _METADATA), "w") as f:
+            json.dump({"created_at": time.time()}, f)
+        return path
+
+    @property
+    def path(self) -> Optional[str]:
+        return self._path
+
+    def __repr__(self):
+        src = self._path if self._path else f"dict[{len(self._dict or {})}]"
+        return f"Checkpoint({src})"
+
+
+class _TrackedCheckpoint:
+    def __init__(self, checkpoint: Checkpoint, metrics: Dict[str, Any],
+                 index: int, path: Optional[str]):
+        self.checkpoint = checkpoint
+        self.metrics = metrics
+        self.index = index
+        self.path = path
+
+
+class CheckpointManager:
+    """Persists reported checkpoints under `root`, keeps top-K by score."""
+
+    def __init__(self, root: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None,
+                 score_order: str = "max"):
+        self.root = root
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._tracked: List[_TrackedCheckpoint] = []
+        self._counter = 0
+        os.makedirs(root, exist_ok=True)
+
+    def register(self, checkpoint: Checkpoint,
+                 metrics: Dict[str, Any]) -> _TrackedCheckpoint:
+        idx = self._counter
+        self._counter += 1
+        path = os.path.join(self.root, f"checkpoint_{idx:06d}")
+        checkpoint.to_directory(path)
+        tracked = _TrackedCheckpoint(Checkpoint.from_directory(path), metrics,
+                                     idx, path)
+        self._tracked.append(tracked)
+        self._evict()
+        return tracked
+
+    def _score(self, t: _TrackedCheckpoint) -> float:
+        if not self.score_attribute:
+            return float(t.index)  # keep most recent
+        v = float(t.metrics.get(self.score_attribute, float("-inf")))
+        return v if self.score_order == "max" else -v
+
+    def _evict(self):
+        if self.num_to_keep is None or len(self._tracked) <= self.num_to_keep:
+            return
+        self._tracked.sort(key=self._score, reverse=True)
+        for victim in self._tracked[self.num_to_keep:]:
+            if victim.path and os.path.exists(victim.path):
+                shutil.rmtree(victim.path, ignore_errors=True)
+        self._tracked = self._tracked[:self.num_to_keep]
+
+    @property
+    def best(self) -> Optional[_TrackedCheckpoint]:
+        if not self._tracked:
+            return None
+        return max(self._tracked, key=self._score)
+
+    @property
+    def latest(self) -> Optional[_TrackedCheckpoint]:
+        if not self._tracked:
+            return None
+        return max(self._tracked, key=lambda t: t.index)
+
+    @property
+    def checkpoints(self) -> List[_TrackedCheckpoint]:
+        return sorted(self._tracked, key=lambda t: t.index)

@@ -1,0 +1,93 @@
+"""Train/AIR-style configuration dataclasses.
+
+Mirrors the reference's air/config.py (ScalingConfig/RunConfig/
+CheckpointConfig/FailureConfig — SURVEY.md §2.4) with TPU-native resource
+semantics: a worker is a *host* of a pod slice, `tpus_per_worker` counts
+chips, and the placement group is the ICI-aware gang (STRICT_SPREAD over
+hosts of one slice).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers (hosts) and what each one holds.
+
+    Ref analog: python/ray/air/config.py ScalingConfig (num_workers,
+    use_gpu, resources_per_worker) — `use_gpu` becomes `use_tpu`.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: Optional[int] = None
+    cpus_per_worker: Optional[int] = None
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None  # e.g. "v5p-64": informs mesh construction
+
+    def bundle(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", self.cpus_per_worker
+                       if self.cpus_per_worker is not None else 1)
+        if self.use_tpu or self.tpus_per_worker:
+            res.setdefault("TPU", self.tpus_per_worker or 1)
+        return res
+
+    def bundles(self) -> List[Dict[str, float]]:
+        return [self.bundle() for _ in range(self.num_workers)]
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Ref analog: air/config.py CheckpointConfig (top-K retention)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"  # or "min"
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Gang-restart policy (ref: air/config.py FailureConfig).
+
+    max_failures: total tolerated worker-group failures; -1 = unlimited.
+    """
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    verbose: int = 1
+
+    def resolved_storage_path(self) -> str:
+        return self.storage_path or os.path.expanduser("~/ray_tpu_results")
+
+
+@dataclasses.dataclass
+class Result:
+    """What `Trainer.fit` returns (ref: air/result.py)."""
+
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Any]  # train.Checkpoint
+    path: Optional[str] = None
+    error: Optional[BaseException] = None
+    metrics_history: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+
+    @property
+    def best_checkpoints(self):
+        return getattr(self, "_best_checkpoints", [])

@@ -8,13 +8,21 @@ JAX backend initializes.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env pins axon (TPU)
 prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (
         prev + " --xla_force_host_platform_device_count=8").strip()
 # Workers inherit this too; keep them off the TPU and quiet.
 os.environ.setdefault("TPU_CHIPS", "0")
+
+# The machine's sitecustomize registers the axon (TPU) PJRT plugin at
+# interpreter startup and rewrites jax's `jax_platforms` config directly, so
+# the env var alone is not enough — override the config too (backends are
+# initialized lazily, so this sticks as long as it runs before first use).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
