@@ -596,6 +596,14 @@ class Head:
             w = node.workers.get(info.worker_id) if node else None
         if w is not None:
             self._kill_worker_process(w)
+            # Reap synchronously: _kill_worker_process marks the worker dead,
+            # which suppresses the conn-close death path — without this the
+            # lease (and its CPU/TPU grant) leaks on every kill().
+            self._handle_worker_death(w)
+            with self._lock:
+                node = self.nodes.get(w.node_idx)
+                if node is not None:
+                    node.workers.pop(w.worker_id, None)
         if no_restart:
             self._publish(f"actor:{aid.hex()}",
                           dumps(("DEAD", "killed via kill()")))
@@ -641,6 +649,9 @@ class Head:
             info.bundle_available.append(rs)
         info.state = "CREATED"
         self.pgs[spec.pg_id] = info
+        # mirror into KV: non-driver processes poll kv_get("pg_state", ...)
+        # from PlacementGroup.ready() (api.py _pg_state)
+        self.kv.setdefault("pg_state", {})[spec.pg_id.hex()] = b"CREATED"
         self._publish(f"pg:{spec.pg_id.hex()}", dumps("CREATED"))
 
     def _retry_pending_pgs(self):
@@ -659,6 +670,7 @@ class Head:
     def _h_remove_pg(self, conn, rid, pg_id_bin):
         pg_id = PlacementGroupID(pg_id_bin)
         with self._lock:
+            self.kv.setdefault("pg_state", {})[pg_id.hex()] = b"REMOVED"
             info = self.pgs.pop(pg_id, None)
             if info and info.state == "CREATED":
                 for b, node_idx, avail in zip(info.spec.bundles,

@@ -48,7 +48,9 @@ def serialize(value: Any) -> SerializedValue:
             if isinstance(obj, ObjectRef):
                 contained_refs.append(obj)
                 return (ObjectRef._deserialize, (obj.id.binary(), obj.owner))
-            return NotImplemented
+            # delegate (NOT NotImplemented): cloudpickle's own
+            # reducer_override is what pickles closures/lambdas by value
+            return super().reducer_override(obj)
 
     sio = io.BytesIO()
     p = _Pickler(sio, protocol=5, buffer_callback=buffers.append)

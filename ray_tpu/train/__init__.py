@@ -1,0 +1,47 @@
+"""ray_tpu.train — distributed training over worker-actor gangs.
+
+Ref analog: python/ray/train + python/ray/air config/session layers
+(SURVEY.md §2.4). TPU-native: the tensor plane is jax.distributed + XLA ICI
+collectives (backend.py), not a NCCL process group.
+"""
+
+from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig
+from ray_tpu.train.backend_executor import (
+    BackendExecutor,
+    TrainingWorkerError,
+)
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.session import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    get_local_rank,
+    get_world_rank,
+    get_world_size,
+    report,
+)
+from ray_tpu.train.trainer import (
+    BaseTrainer,
+    DataParallelTrainer,
+    JaxTrainer,
+)
+from ray_tpu.train.worker_group import RayTrainWorker, WorkerGroup
+
+__all__ = [
+    "ScalingConfig", "RunConfig", "CheckpointConfig", "FailureConfig",
+    "Result", "Checkpoint", "CheckpointManager",
+    "Backend", "BackendConfig", "JaxConfig",
+    "BackendExecutor", "TrainingWorkerError",
+    "BaseTrainer", "DataParallelTrainer", "JaxTrainer",
+    "WorkerGroup", "RayTrainWorker",
+    "report", "get_checkpoint", "get_context", "get_dataset_shard",
+    "get_world_rank", "get_world_size", "get_local_rank", "TrainContext",
+]

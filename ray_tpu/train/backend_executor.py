@@ -41,6 +41,7 @@ class BackendExecutor:
                        checkpoint=None, dataset_shards=None,
                        experiment_name: str = "", trial_id: str = ""):
         assert self.worker_group is not None, "call start() first"
+        self._done_ranks = set()
         n = self._num_workers
         refs = []
         for rank, w in enumerate(self.worker_group.workers):
@@ -63,23 +64,33 @@ class BackendExecutor:
                      for w in self.worker_group.workers])
 
     def next_results(self) -> Optional[List[Any]]:
-        """One round: the next result from every worker (lock-step, like the
-        reference's TrainingIterator). None once all workers are done."""
+        """One round: the next result from every still-running worker
+        (lock-step, like the reference's TrainingIterator). None once all
+        workers are done. Workers that already returned their 'done'
+        sentinel are not polled again (their queues are empty — polling
+        would block forever on uneven loop lengths)."""
         assert self.worker_group is not None
+        if not hasattr(self, "_done_ranks"):
+            self._done_ranks = set()
+        live = [(rank, w)
+                for rank, w in enumerate(self.worker_group.workers)
+                if rank not in self._done_ranks]
+        if not live:
+            return None
         try:
-            results = ray_tpu.get([w.get_next.remote()
-                                   for w in self.worker_group.workers])
+            results = ray_tpu.get([w.get_next.remote() for _, w in live])
         except Exception as e:  # worker raised or died
             raise TrainingWorkerError(str(e)) from e
-        kinds = {kind for kind, _ in results}
-        if kinds == {"done"}:
-            return None
-        if "done" in kinds:
-            # Mixed finish (e.g. uneven loops): treat remaining reports as
-            # the last round and finish after.
-            return [payload for kind, payload in results
-                    if kind == "report"] or None
-        return [payload for _, payload in results]
+        reports = []
+        for (rank, _), (kind, payload) in zip(live, results):
+            if kind == "done":
+                self._done_ranks.add(rank)
+            else:
+                reports.append(payload)
+        if not reports:
+            return None if len(self._done_ranks) == self._num_workers \
+                else self.next_results()
+        return reports
 
     def shutdown(self):
         if self.worker_group is not None:

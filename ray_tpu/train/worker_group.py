@@ -67,12 +67,21 @@ class RayTrainWorker:
 
 class WorkerGroup:
     def __init__(self, num_workers: int, resources_per_worker: Dict[str, float],
-                 placement_strategy: str = "PACK"):
+                 placement_strategy: str = "PACK",
+                 pg_timeout_s: float = 600.0):
         self.num_workers = num_workers
         bundles = [dict(resources_per_worker) for _ in range(num_workers)]
         self._pg = ray_tpu.placement_group(bundles,
                                            strategy=placement_strategy)
-        self._pg.ready(timeout=60.0)
+        if not self._pg.ready(timeout=pg_timeout_s):
+            try:
+                ray_tpu.remove_placement_group(self._pg)
+            except Exception:
+                pass
+            raise TimeoutError(
+                f"placement group for {num_workers}x{resources_per_worker} "
+                f"not ready after {pg_timeout_s}s (cluster busy or gang "
+                "infeasible)")
         cpus = resources_per_worker.get("CPU", 1)
         extra = {k: v for k, v in resources_per_worker.items()
                  if k not in ("CPU", "TPU")}

@@ -1,0 +1,47 @@
+"""ray_tpu.tune — hyperparameter tuning over trial actors.
+
+Ref analog: python/ray/tune (Tuner tuner.py:59, TuneController
+execution/tune_controller.py:80, Trainable trainable/trainable.py:75,
+schedulers/, search/ — SURVEY.md §2.4). One trial = one actor; the
+controller pumps ``train()`` futures and applies scheduler decisions.
+"""
+
+from .result_grid import ResultGrid
+from .schedulers import (
+    ASHAScheduler,
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from .search import (
+    BasicVariantGenerator,
+    RandomSearch,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    qrandint,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from .session import get_checkpoint, report
+from .trainable import FunctionTrainable, Trainable, with_parameters
+from .trial import Trial
+from .tuner import TuneConfig, Tuner, run
+
+__all__ = [
+    "Tuner", "TuneConfig", "run", "ResultGrid", "Trial",
+    "Trainable", "FunctionTrainable", "with_parameters",
+    "report", "get_checkpoint",
+    "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
+    "ASHAScheduler", "HyperBandScheduler", "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "Searcher", "BasicVariantGenerator", "RandomSearch",
+    "choice", "uniform", "loguniform", "quniform", "randint", "qrandint",
+    "grid_search", "sample_from",
+]

@@ -1,0 +1,226 @@
+"""Search spaces and search algorithms.
+
+Ref analogs: python/ray/tune/search/sample.py (Domain/Categorical/Float/
+Integer, grid_search), python/ray/tune/search/basic_variant.py
+(BasicVariantGenerator — grid cross-product x num_samples random draws),
+python/ray/tune/search/search_algorithm.py. Re-designed small: a Domain is
+a picklable sampler; variant generation is an explicit cross-product over
+grid axes with independent random draws for stochastic axes.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, Iterator, List, Optional
+
+
+class Domain:
+    """A samplable hyperparameter axis."""
+
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Categorical(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+    def __repr__(self):
+        return f"choice({self.categories})"
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False,
+                 q: Optional[float] = None):
+        if log and lower <= 0:
+            raise ValueError("loguniform requires lower > 0")
+        self.lower, self.upper, self.log, self.q = lower, upper, log, q
+
+    def sample(self, rng):
+        if self.log:
+            import math
+
+            v = math.exp(rng.uniform(math.log(self.lower),
+                                     math.log(self.upper)))
+        else:
+            v = rng.uniform(self.lower, self.upper)
+        if self.q:
+            v = round(v / self.q) * self.q
+        return v
+
+    def __repr__(self):
+        return f"{'log' if self.log else ''}uniform({self.lower},{self.upper})"
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int, q: int = 1):
+        self.lower, self.upper, self.q = lower, upper, q
+
+    def sample(self, rng):
+        v = rng.randrange(self.lower, self.upper)
+        return (v // self.q) * self.q
+
+    def __repr__(self):
+        return f"randint({self.lower},{self.upper})"
+
+
+class GridSearch:
+    """Marker for exhaustive axes (ref: tune/search/sample.py grid_search)."""
+
+    def __init__(self, values):
+        self.values = list(values)
+
+
+def choice(categories) -> Categorical:
+    return Categorical(categories)
+
+
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def quniform(lower: float, upper: float, q: float) -> Float:
+    return Float(lower, upper, q=q)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def qrandint(lower: int, upper: int, q: int) -> Integer:
+    return Integer(lower, upper, q=q)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+def sample_from(fn) -> "SampleFrom":
+    return SampleFrom(fn)
+
+
+class SampleFrom(Domain):
+    """Callable domain: fn(spec: dict so-far) -> value."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def sample(self, rng):  # resolved later with the partial config
+        raise RuntimeError("SampleFrom is resolved by the generator")
+
+
+# --------------------------------------------------------------- generation
+
+
+def _split_space(space: Dict[str, Any], prefix=()):
+    """Walk a (possibly nested-dict) space; yield (path, domain-or-literal)."""
+    for k, v in space.items():
+        path = prefix + (k,)
+        if isinstance(v, dict):
+            yield from _split_space(v, path)
+        else:
+            yield path, v
+
+
+def _set_path(cfg: dict, path, value):
+    d = cfg
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def generate_variants(space: Dict[str, Any], num_samples: int,
+                      seed: Optional[int] = None) -> Iterator[Dict[str, Any]]:
+    """Cross-product of grid axes × num_samples random draws.
+
+    Matches the reference's semantics (basic_variant.py): each of the
+    `num_samples` repetitions enumerates the full grid; stochastic axes are
+    redrawn per variant.
+    """
+    rng = random.Random(seed)
+    leaves = list(_split_space(space))
+    grid_axes = [(p, v.values) for p, v in leaves if isinstance(v, GridSearch)]
+    grid_iter = list(itertools.product(*[vals for _, vals in grid_axes])) \
+        if grid_axes else [()]
+    for _ in range(num_samples):
+        for combo in grid_iter:
+            cfg: Dict[str, Any] = {}
+            for p, v in leaves:
+                if isinstance(v, GridSearch):
+                    continue
+                if isinstance(v, SampleFrom):
+                    continue  # second pass, needs partial config
+                _set_path(cfg, p, v.sample(rng) if isinstance(v, Domain)
+                          else v)
+            for (p, _), val in zip(grid_axes, combo):
+                _set_path(cfg, p, val)
+            for p, v in leaves:
+                if isinstance(v, SampleFrom):
+                    _set_path(cfg, p, v.fn(cfg))
+            yield cfg
+
+
+class Searcher:
+    """Suggestion-based search base (ref: tune/search/searcher.py).
+
+    Subclasses propose configs one at a time and receive completed-trial
+    feedback; wraps external optimizers.
+    """
+
+    def __init__(self, metric: str = None, mode: str = "max"):
+        self.metric, self.mode = metric, mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict] = None,
+                          error: bool = False):
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Default searcher: pre-expanded grid/random variants."""
+
+    def __init__(self, space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None, **kw):
+        super().__init__(**kw)
+        self._variants = list(generate_variants(space, num_samples, seed))
+        self._idx = 0
+
+    @property
+    def total(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._idx >= len(self._variants):
+            return None
+        cfg = self._variants[self._idx]
+        self._idx += 1
+        return cfg
+
+
+class RandomSearch(Searcher):
+    """Unbounded random sampler over a space (no grid axes)."""
+
+    def __init__(self, space: Dict[str, Any], seed: Optional[int] = None,
+                 **kw):
+        super().__init__(**kw)
+        self._space = space
+        self._rng = random.Random(seed)
+
+    def suggest(self, trial_id: str):
+        cfg: Dict[str, Any] = {}
+        for p, v in _split_space(self._space):
+            if isinstance(v, GridSearch):
+                v = Categorical(v.values)
+            _set_path(cfg, p, v.sample(self._rng)
+                      if isinstance(v, Domain) else v)
+        return cfg

@@ -1,0 +1,40 @@
+"""Regression: kill() must release the actor's lease (CPU grant).
+
+Without the synchronous reap in Head._h_kill_actor the grant leaked on
+every kill, starving later actor creations (surfaced as Tune trials dying
+with "creation timed out").
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def fresh_rt():
+    info = ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_kill_releases_actor_resources(fresh_rt):
+    @ray_tpu.remote
+    class Holder:
+        def ping(self):
+            return 1
+
+    before = ray_tpu.available_resources().get("CPU", 0)
+    assert before >= 2
+    actors = [Holder.options(num_cpus=1).remote() for _ in range(2)]
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=60)
+    assert ray_tpu.available_resources().get("CPU", 0) == before - 2
+    for a in actors:
+        ray_tpu.kill(a)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if ray_tpu.available_resources().get("CPU", 0) == before:
+            break
+        time.sleep(0.05)
+    assert ray_tpu.available_resources().get("CPU", 0) == before

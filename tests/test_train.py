@@ -1,0 +1,195 @@
+"""Train layer tests (ref model: python/ray/train/tests/test_backend.py et
+al — SURVEY.md §4.5)."""
+
+import os
+import tempfile
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (
+    Checkpoint,
+    DataParallelTrainer,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@pytest.fixture(scope="module")
+def runtime():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_single_worker_fit(runtime, tmp_path):
+    def loop(config):
+        for i in range(3):
+            train.report({"step": i, "loss": 1.0 / (i + 1)})
+
+    result = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t1", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert len(result.metrics_history) == 3
+
+
+def test_multi_worker_ranks(runtime, tmp_path):
+    def loop(config):
+        ctx = train.get_context()
+        train.report({"rank": ctx.world_rank, "world": ctx.world_size})
+
+    result = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t2", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["world"] == 2
+    assert result.metrics["rank"] == 0  # rank-0 metrics win
+
+
+def test_checkpoint_roundtrip_and_topk(runtime, tmp_path):
+    def loop(config):
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            start = ckpt.to_dict()["step"] + 1
+        for i in range(start, 4):
+            train.report({"score": float(i)},
+                         checkpoint=Checkpoint.from_dict({"step": i}))
+
+    rc = RunConfig(name="t3", storage_path=str(tmp_path),
+                   checkpoint_config=train.CheckpointConfig(
+                       num_to_keep=2, checkpoint_score_attribute="score"))
+    result = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        run_config=rc).fit()
+    assert result.error is None
+    assert result.checkpoint.to_dict()["step"] == 3
+    ckpt_dir = os.path.join(str(tmp_path), "t3", "checkpoints")
+    assert len(os.listdir(ckpt_dir)) == 2  # top-K retention
+
+    # resume continues from the saved step without redoing work
+    result2 = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t3b", storage_path=str(tmp_path)),
+        resume_from_checkpoint=result.checkpoint).fit()
+    assert result2.metrics_history == []  # start==4, loop body skipped
+
+
+def test_gang_restart_on_failure(runtime, tmp_path):
+    marker = os.path.join(tempfile.mkdtemp(), "boom")
+
+    def loop(config):
+        ckpt = train.get_checkpoint()
+        start = ckpt.to_dict()["step"] + 1 if ckpt else 0
+        for i in range(start, 4):
+            train.report({"step": i},
+                         checkpoint=Checkpoint.from_dict({"step": i}))
+            if i == 1 and not os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                raise RuntimeError("worker down")
+
+    result = DataParallelTrainer(
+        loop, train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t4", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["step"] == 3
+
+
+def test_failure_exhausted_surfaces_error(runtime, tmp_path):
+    def loop(config):
+        raise RuntimeError("always fails")
+
+    result = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t5", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is not None
+
+
+def test_jax_trainer_real_step(runtime, tmp_path):
+    """End-to-end: a tiny jitted train step inside the worker (single host,
+    no jax.distributed — JaxConfig auto mode)."""
+
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        w = jnp.zeros((4,))
+        tx = optax.sgd(0.1)
+        opt = tx.init(w)
+        x = jnp.ones((8, 4))
+        y = jnp.ones((8,))
+
+        @jax.jit
+        def step(w, opt):
+            def loss_fn(w):
+                return jnp.mean((x @ w - y) ** 2)
+
+            loss, g = jax.value_and_grad(loss_fn)(w)
+            up, opt = tx.update(g, opt)
+            return optax.apply_updates(w, up), opt, loss
+
+        for i in range(5):
+            w, opt, loss = step(w, opt)
+            train.report({"loss": float(loss)})
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="jax1", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None
+    losses = [m["loss"] for m in result.metrics_history]
+    assert losses[-1] < losses[0]
+
+
+def test_trainer_as_tune_trainable(runtime, tmp_path):
+    from ray_tpu import tune
+
+    def loop(config):
+        train.report({"final": config["lr"] * 10})
+
+    trainer = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="tt", storage_path=str(tmp_path)))
+    results = tune.Tuner(
+        trainer.as_trainable(),
+        param_space={"lr": tune.grid_search([0.1, 0.2])},
+        tune_config=tune.TuneConfig(metric="final", mode="max"),
+    ).fit()
+    assert results.get_best_result().metrics["final"] == pytest.approx(2.0)
+
+
+def test_uneven_worker_loops(runtime, tmp_path):
+    """Regression: a worker finishing earlier than its peers must not
+    deadlock the result pump (next_results used to re-poll drained
+    workers)."""
+
+    def loop(config):
+        ctx = train.get_context()
+        rounds = 2 if ctx.world_rank == 0 else 4
+        for i in range(rounds):
+            train.report({"i": i, "rank": ctx.world_rank})
+
+    result = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="uneven", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None
+    # 2 lock-step rounds + 2 solo rounds from the longer worker
+    assert len(result.metrics_history) == 4
