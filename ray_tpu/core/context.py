@@ -164,6 +164,7 @@ class CoreContext:
         self._classes: Dict[tuple, _ClassState] = {}
         self._inflight: Dict[TaskID, _InflightTask] = {}
         self._return_to_task: Dict[ObjectID, TaskID] = {}
+        self._dep_unready: set = set()  # actor tasks awaiting arg resolution
         self._sub_lock = threading.RLock()
         self._submit_event = threading.Event()
         self._submitter = threading.Thread(target=self._submitter_loop,
@@ -427,7 +428,9 @@ class CoreContext:
                     arg_ids.append(r.id)
                     holder.append(r)
                     self.ref_counter.add_task_arg(r.id)
-                encoded.append((ARG_VALUE, sv.frames))
+                encoded.append((ARG_VALUE,
+                                [bytes(f) if isinstance(f, memoryview)
+                                 else f for f in sv.frames]))
         spec.args = encoded
         spec.kwarg_names = list(kwargs.keys())
         return arg_ids, holder
@@ -463,10 +466,66 @@ class CoreContext:
             self._inflight[spec.task_id] = inflight
             for oid in spec.return_ids():
                 self._return_to_task[oid] = spec.task_id
+        self._resolve_then(spec, holder,
+                           lambda: self._enqueue_ready(spec, cls))
+        return refs
+
+    def _enqueue_ready(self, spec: TaskSpec, cls):
+        with self._sub_lock:
             st = self._classes.setdefault(cls, _ClassState())
             st.queue.append(spec)
         self._submit_event.set()
-        return refs
+
+    def _resolve_then(self, spec: TaskSpec, holder, on_ready, on_error=None):
+        """Submitter-side dependency resolution (the reference's
+        LocalDependencyResolver, core_worker/transport/dependency_resolver.h):
+        hold the task until every *owned* arg object is ready, propagate an
+        upstream error straight to this task's returns, and promote
+        inline-only values into the shm store so the executing worker can
+        fetch them by location. Borrowed refs resolve via the owner's
+        promotion at lend time + head locate."""
+        owned: Dict[ObjectID, ObjectRef] = {}
+        for ref in holder:
+            if (ref.owner or self.worker_id) == self.worker_id:
+                owned.setdefault(ref.id, ref)
+
+        def finalize():
+            err = None
+            for oid, ref in owned.items():
+                e = self.memory_store.peek(oid)
+                if e is None:
+                    continue
+                if e.is_error:
+                    err = e.value
+                    break
+                if not e.in_plasma:
+                    self._promote_if_needed(ref)
+            if err is not None:
+                if on_error is not None:
+                    on_error(err)
+                else:
+                    self._complete_task_error(spec, err)
+                    self._submit_event.set()
+            else:
+                on_ready()
+
+        pending = [oid for oid in owned
+                   if not self.memory_store.contains(oid)]
+        if not pending:
+            finalize()
+            return
+        state = {"n": len(pending)}
+        lock = threading.Lock()
+
+        def cb():
+            with lock:
+                state["n"] -= 1
+                done = state["n"] == 0
+            if done:
+                finalize()
+
+        for oid in pending:
+            self.memory_store.add_ready_callback(oid, cb)
 
     def _submitter_loop(self):
         while not self._shutdown:
@@ -612,7 +671,10 @@ class CoreContext:
 
     def _complete_task_error(self, spec: TaskSpec, err: Exception):
         for oid in spec.return_ids():
-            self.memory_store.put_value(oid, err, is_error=True)
+            # don't clobber results that already arrived (e.g. an actor
+            # killed right after its last reply was stored)
+            if not self.memory_store.contains(oid):
+                self.memory_store.put_value(oid, err, is_error=True)
         self._finalize_task(spec)
 
     def _finalize_task(self, spec: TaskSpec):
@@ -763,7 +825,23 @@ class CoreContext:
         with st.lock:
             spec.seqno = next(st.seqno)
             st.queue.append(spec)
-        self._drain_actor(st)
+            self._dep_unready.add(spec.task_id)
+
+        def ready():
+            self._dep_unready.discard(spec.task_id)
+            self._drain_actor(st)
+
+        def failed(err):
+            self._dep_unready.discard(spec.task_id)
+            with st.lock:
+                try:
+                    st.queue.remove(spec)
+                except ValueError:
+                    pass
+            self._complete_task_error(spec, err)
+            self._drain_actor(st)
+
+        self._resolve_then(spec, holder, ready, failed)
         return refs
 
     def _drain_actor(self, st: _ActorState):
@@ -787,6 +865,10 @@ class CoreContext:
                 return
             to_send = []
             while st.queue:
+                # head-of-line gate: actor-task order is by seqno, so a task
+                # whose deps are still resolving blocks those behind it
+                if st.queue[0].task_id in self._dep_unready:
+                    break
                 spec = st.queue.popleft()
                 st.inflight[spec.task_id] = spec
                 to_send.append(spec)
@@ -1032,7 +1114,10 @@ class CoreContext:
             sv = serialize(value)
             if sv.total_bytes < cfg.max_inline_object_size and \
                     not sv.contained_refs:
-                meta.append(("v", sv.frames))
+                # out-of-band frames may be memoryviews (PickleBuffer.raw);
+                # materialize them — the reply itself is pickled in-band
+                meta.append(("v", [bytes(f) if isinstance(f, memoryview)
+                                   else f for f in sv.frames]))
             else:
                 self.store.put_serialized(oid, sv.frames)
                 self.head.send(P.OBJECT_SEALED, oid.binary(), self.node_idx,

@@ -1,0 +1,202 @@
+"""Blocks: the unit of data movement in ray_tpu.data.
+
+Ref analogs: python/ray/data/block.py (BlockAccessor), _internal/arrow_block.py
+and _internal/simple_block.py. A block is either a pyarrow.Table (tabular
+rows) or a plain Python list (simple block of arbitrary objects). Blocks
+live in the object store; tasks move BlockRefs, not data.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+try:
+    import pyarrow as pa
+except ImportError:  # pragma: no cover
+    pa = None
+
+Block = Union["pa.Table", List[Any]]
+
+
+def build_block(rows: List[Any]) -> Block:
+    """Rows of dicts -> Arrow table; anything else -> simple block."""
+    if pa is not None and rows and all(isinstance(r, dict) for r in rows):
+        try:
+            return pa.Table.from_pylist(rows)
+        except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
+            return list(rows)
+    return list(rows)
+
+
+def from_pandas(df) -> Block:
+    if pa is not None:
+        return pa.Table.from_pandas(df, preserve_index=False)
+    return df.to_dict("records")
+
+
+def from_numpy(data: Union[np.ndarray, Dict[str, np.ndarray]]) -> Block:
+    if isinstance(data, np.ndarray):
+        data = {"data": data}
+    cols = {}
+    for name, arr in data.items():
+        arr = np.asarray(arr)
+        if arr.ndim > 1:
+            # tensor column: store as fixed-size-list of flattened rows
+            flat = arr.reshape(arr.shape[0], -1)
+            cols[name] = pa.FixedSizeListArray.from_arrays(
+                pa.array(flat.ravel()), flat.shape[1])
+            cols[f"__shape__{name}"] = pa.array(
+                [list(arr.shape[1:])] * arr.shape[0])
+        else:
+            cols[name] = pa.array(arr)
+    return pa.Table.from_pydict(cols)
+
+
+class BlockAccessor:
+    """Uniform view over either block representation."""
+
+    def __init__(self, block: Block):
+        self._block = block
+        self._is_arrow = pa is not None and isinstance(block, pa.Table)
+
+    @property
+    def block(self) -> Block:
+        return self._block
+
+    def num_rows(self) -> int:
+        if self._is_arrow:
+            return self._block.num_rows
+        return len(self._block)
+
+    def size_bytes(self) -> int:
+        if self._is_arrow:
+            return self._block.nbytes
+        import sys
+
+        return sum(sys.getsizeof(r) for r in self._block)
+
+    def schema(self):
+        if self._is_arrow:
+            return self._block.schema
+        if self._block:
+            first = self._block[0]
+            return type(first).__name__
+        return None
+
+    # ----------------------------------------------------------- conversion
+
+    def iter_rows(self) -> Iterator[Any]:
+        if self._is_arrow:
+            shape_cols = [c for c in self._block.column_names
+                          if c.startswith("__shape__")]
+            for row in self._block.to_pylist():
+                for sc in shape_cols:
+                    name = sc[len("__shape__"):]
+                    shape = row.pop(sc)
+                    row[name] = np.asarray(row[name]).reshape(shape)
+                yield row
+        else:
+            yield from self._block
+
+    def to_pylist(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def to_pandas(self):
+        import pandas as pd
+
+        if self._is_arrow:
+            drop = [c for c in self._block.column_names
+                    if c.startswith("__shape__")]
+            return self._block.drop_columns(drop).to_pandas() if drop \
+                else self._block.to_pandas()
+        if self._block and isinstance(self._block[0], dict):
+            return pd.DataFrame(self._block)
+        return pd.DataFrame({"value": self._block})
+
+    def to_numpy(self, columns: Optional[List[str]] = None
+                 ) -> Dict[str, np.ndarray]:
+        if self._is_arrow:
+            out = {}
+            names = columns or [c for c in self._block.column_names
+                                if not c.startswith("__shape__")]
+            for name in names:
+                col = self._block.column(name)
+                arr = col.to_numpy(zero_copy_only=False)
+                shape_col = f"__shape__{name}"
+                if shape_col in self._block.column_names and \
+                        self._block.num_rows:
+                    shape = self._block.column(shape_col)[0].as_py()
+                    arr = np.stack([np.asarray(x).reshape(shape)
+                                    for x in arr])
+                out[name] = arr
+            return out
+        rows = self.to_pylist()
+        if rows and isinstance(rows[0], dict):
+            keys = columns or list(rows[0])
+            return {k: np.asarray([r[k] for r in rows]) for k in keys}
+        return {"value": np.asarray(rows)}
+
+    def to_arrow(self):
+        if self._is_arrow:
+            return self._block
+        return build_block(self.to_pylist())
+
+    def to_batch(self, batch_format: str):
+        if batch_format in ("numpy", "np"):
+            return self.to_numpy()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("pyarrow", "arrow"):
+            return self.to_arrow()
+        if batch_format in ("default", "native"):
+            return self.to_numpy() if self._is_arrow else self._block
+        raise ValueError(f"unknown batch_format '{batch_format}'")
+
+    # ------------------------------------------------------------- slicing
+
+    def slice(self, start: int, end: int) -> Block:
+        if self._is_arrow:
+            return self._block.slice(start, end - start)
+        return self._block[start:end]
+
+    def take_rows(self, indices: List[int]) -> Block:
+        if self._is_arrow:
+            return self._block.take(pa.array(indices, type=pa.int64()))
+        return [self._block[i] for i in indices]
+
+    @staticmethod
+    def concat(blocks: List[Block]) -> Block:
+        if not blocks:
+            return []
+        if pa is not None and all(isinstance(b, pa.Table) for b in blocks):
+            tables = [b for b in blocks if b.num_rows]
+            if not tables:
+                return blocks[0]
+            try:
+                return pa.concat_tables(tables, promote_options="default")
+            except (pa.ArrowInvalid, TypeError):
+                pass
+        rows: List[Any] = []
+        for b in blocks:
+            rows.extend(BlockAccessor(b).to_pylist())
+        return rows
+
+
+def batch_to_block(batch: Any) -> Block:
+    """Normalize a user map_batches return value into a block."""
+    import pandas as pd
+
+    if pa is not None and isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, pd.DataFrame):
+        return from_pandas(batch)
+    if isinstance(batch, dict):
+        arrays = {k: np.asarray(v) for k, v in batch.items()}
+        return from_numpy(arrays)
+    if isinstance(batch, list):
+        return build_block(batch)
+    raise TypeError(
+        f"map_batches must return dict[str, np.ndarray] | pd.DataFrame | "
+        f"pyarrow.Table | list, got {type(batch)}")

@@ -1,0 +1,183 @@
+"""Datasources: lazily-evaluated read tasks.
+
+Ref analogs: python/ray/data/datasource/ (Datasource/ReadTask) and
+read_api.py:294. A Datasource yields ReadTasks — zero-arg callables, each
+producing one block — which the executor runs as remote tasks.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+
+import numpy as np
+
+from .block import Block, build_block, from_numpy, from_pandas
+
+ReadTask = Callable[[], Block]
+
+
+class Datasource:
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def estimate_inmemory_size(self) -> Optional[int]:
+        return None
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, column: str = "id"):
+        self.n = n
+        self.column = column
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, self.n or 1))
+        chunk = -(-self.n // parallelism)
+        tasks = []
+        col = self.column
+        for start in range(0, self.n, chunk):
+            end = min(start + chunk, self.n)
+
+            def task(start=start, end=end):
+                return from_numpy({col: np.arange(start, end)})
+
+            tasks.append(task)
+        return tasks or [lambda: build_block([])]
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: List[Any]):
+        self.items = list(items)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = len(self.items)
+        parallelism = max(1, min(parallelism, n or 1))
+        chunk = -(-n // parallelism) if n else 1
+        tasks = []
+        for start in range(0, n, chunk):
+            part = self.items[start:start + chunk]
+            tasks.append(lambda part=part: build_block(part))
+        return tasks or [lambda: build_block([])]
+
+
+def _expand_paths(paths: Union[str, List[str]], suffix: str) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(_glob.glob(os.path.join(p, f"*{suffix}"))))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files found for {paths}")
+    return out
+
+
+class FileDatasource(Datasource):
+    """One read task per file (parallelism capped at #files)."""
+
+    suffix = ""
+
+    def __init__(self, paths: Union[str, List[str]], **options):
+        self.paths = _expand_paths(paths, self.suffix)
+        self.options = options
+
+    def read_file(self, path: str) -> Block:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        return [lambda p=p: self.read_file(p) for p in self.paths]
+
+
+class ParquetDatasource(FileDatasource):
+    suffix = ".parquet"
+
+    def read_file(self, path: str) -> Block:
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path, columns=self.options.get("columns"))
+
+
+class CSVDatasource(FileDatasource):
+    suffix = ".csv"
+
+    def read_file(self, path: str) -> Block:
+        from pyarrow import csv as pa_csv
+
+        return pa_csv.read_csv(path)
+
+
+class JSONDatasource(FileDatasource):
+    suffix = ".json"
+
+    def read_file(self, path: str) -> Block:
+        import json
+
+        import pyarrow as pa
+
+        with open(path) as f:
+            text = f.read().strip()
+        try:
+            data = json.loads(text)
+            if isinstance(data, dict):
+                data = [data]
+        except json.JSONDecodeError:  # JSONL
+            data = [json.loads(line) for line in text.splitlines() if line]
+        return pa.Table.from_pylist(data)
+
+
+class NumpyDatasource(FileDatasource):
+    suffix = ".npy"
+
+    def read_file(self, path: str) -> Block:
+        return from_numpy({self.options.get("column", "data"):
+                           np.load(path)})
+
+
+class BinaryDatasource(FileDatasource):
+    def read_file(self, path: str) -> Block:
+        with open(path, "rb") as f:
+            return build_block([{"bytes": f.read(), "path": path}])
+
+
+class TextDatasource(FileDatasource):
+    suffix = ".txt"
+
+    def read_file(self, path: str) -> Block:
+        with open(path) as f:
+            return build_block([{"text": line.rstrip("\n")} for line in f])
+
+
+# ------------------------------------------------------------------ writers
+
+
+def write_block_to_file(block: Block, path: str, file_format: str):
+    from .block import BlockAccessor
+
+    acc = BlockAccessor(block)
+    if file_format == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(acc.to_arrow(), path)
+    elif file_format == "csv":
+        from pyarrow import csv as pa_csv
+
+        pa_csv.write_csv(acc.to_arrow(), path)
+    elif file_format == "json":
+        import json
+
+        with open(path, "w") as f:
+            for row in acc.iter_rows():
+                f.write(json.dumps(
+                    {k: (v.tolist() if isinstance(v, np.ndarray) else v)
+                     for k, v in row.items()}) + "\n")
+    else:
+        raise ValueError(f"unknown write format {file_format}")

@@ -1,0 +1,353 @@
+"""Streaming executor: runs a logical plan as a pipelined task graph.
+
+Ref analog: python/ray/data/_internal/execution/streaming_executor.py:49 —
+a pull-based operator pipeline with bounded in-flight work. Re-designed at
+block granularity: adjacent one-to-one ops are fused into a single remote
+task per block (OperatorFusionRule analog); a block flows to its fused
+transform as soon as its upstream task finishes (no stage barrier); barrier
+ops (repartition/shuffle/sort/groupby) run as two-phase task graphs like
+the reference's push-based shuffle.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.core.object_ref import ObjectRef
+
+from .block import BlockAccessor, batch_to_block, build_block
+from .plan import (ActorPoolStrategy, AllToAll, InputData, Limit, MapBlocks,
+                   Plan, Read, Union, Zip)
+
+# bounded in-flight tasks per stage (the streaming backpressure knob;
+# ref: streaming executor resource budgets)
+_MAX_IN_FLIGHT = 16
+
+
+# ------------------------------------------------------------ fused mapper
+
+
+def _apply_one(op: MapBlocks, block):
+    acc = BlockAccessor(block)
+    kind, fn = op.kind, op.fn
+    if kind == "map_batches":
+        out_blocks = []
+        n = acc.num_rows()
+        bs = op.batch_size or n or 1
+        for start in range(0, max(n, 1), bs):
+            if n == 0:
+                break
+            sub = BlockAccessor(acc.slice(start, min(start + bs, n)))
+            batch = sub.to_batch(op.batch_format)
+            res = fn(batch, *op.fn_args, **op.fn_kwargs)
+            out_blocks.append(batch_to_block(res))
+        return BlockAccessor.concat(out_blocks) if out_blocks else \
+            build_block([])
+    if kind == "map":
+        return build_block([fn(r) for r in acc.iter_rows()])
+    if kind == "filter":
+        return build_block([r for r in acc.iter_rows() if fn(r)])
+    if kind == "flat_map":
+        out = []
+        for r in acc.iter_rows():
+            out.extend(fn(r))
+        return build_block(out)
+    if kind == "add_column":
+        name, col_fn = fn
+        rows = []
+        for r in acc.iter_rows():
+            r = dict(r)
+            r[name] = col_fn(r)
+            rows.append(r)
+        return build_block(rows)
+    if kind == "drop_columns":
+        return build_block([{k: v for k, v in r.items() if k not in fn}
+                            for r in acc.iter_rows()])
+    if kind == "select_columns":
+        return build_block([{k: r[k] for k in fn}
+                            for r in acc.iter_rows()])
+    raise ValueError(f"unknown map kind {kind}")
+
+
+def _run_fused(ops: List[MapBlocks], block):
+    for op in ops:
+        op = _instantiate(op)
+        block = _apply_one(op, block)
+    return block
+
+
+def _instantiate(op: MapBlocks) -> MapBlocks:
+    """Callable-class UDFs are constructed once per task here (actor pools
+    construct once per actor instead)."""
+    fn = op.fn
+    if isinstance(fn, type):
+        import dataclasses as _dc
+
+        fn = fn(*(op.fn_constructor_args or ()))
+        op = _dc.replace(op, fn=fn)
+    return op
+
+
+class _PoolWorker:
+    """Actor for ActorPoolStrategy: holds the constructed UDF."""
+
+    def __init__(self, ops_payload: bytes):
+        from ray_tpu.core.serialization import loads
+
+        ops = loads(ops_payload)
+        self._ops = [_instantiate(op) for op in ops]
+
+    def apply(self, block):
+        for op in self._ops:
+            block = _apply_one(op, block)
+        return block
+
+
+# -------------------------------------------------------------- all-to-all
+
+
+def _split_for_partition(block, n: int, kind: str, seed, key):
+    """Phase 1 of a two-phase exchange: split one block into n parts."""
+    acc = BlockAccessor(block)
+    rows = acc.to_pylist()
+    parts: List[List[Any]] = [[] for _ in range(n)]
+    if kind == "repartition":
+        for i, r in enumerate(rows):
+            parts[i % n].append(r)
+    elif kind == "random_shuffle":
+        rng = random.Random(seed)
+        for r in rows:
+            parts[rng.randrange(n)].append(r)
+    elif kind == "sort":
+        boundaries = key  # (sort_key, boundaries)
+        sort_key, bounds = boundaries
+        for r in rows:
+            v = _key_of(r, sort_key)
+            idx = sum(1 for b in bounds if v > b)
+            parts[idx].append(r)
+    elif kind == "groupby":
+        for r in rows:
+            parts[hash(_key_of(r, key)) % n].append(r)
+    else:
+        raise ValueError(kind)
+    return tuple(build_block(p) for p in parts)
+
+
+def _key_of(row, key):
+    if callable(key):
+        return key(row)
+    if isinstance(row, dict):
+        return row[key]
+    return row
+
+
+def _merge_parts(kind, key, seed, descending, *parts):
+    rows: List[Any] = []
+    for p in parts:
+        rows.extend(BlockAccessor(p).to_pylist())
+    if kind == "random_shuffle":
+        random.Random(seed).shuffle(rows)
+    elif kind == "sort":
+        rows.sort(key=lambda r: _key_of(r, key), reverse=descending)
+    return build_block(rows)
+
+
+def _sample_keys(block, key, k: int):
+    acc = BlockAccessor(block)
+    rows = acc.to_pylist()
+    rng = random.Random(0)
+    picks = rows if len(rows) <= k else rng.sample(rows, k)
+    return [_key_of(r, key) for r in picks]
+
+
+# --------------------------------------------------------------- executor
+
+
+class StreamingExecutor:
+    def __init__(self, plan: Plan):
+        self.plan = plan
+
+    # stage compilation: group the linear op chain into
+    # [source] [fused maps | barrier | limit | union | zip]*
+    def execute(self) -> List[ObjectRef]:
+        ops = self.plan.ops
+        assert ops, "empty plan"
+        refs = self._run_source(ops[0])
+        i = 1
+        while i < len(ops):
+            op = ops[i]
+            if isinstance(op, MapBlocks):
+                fused = []
+                while i < len(ops) and isinstance(ops[i], MapBlocks) and \
+                        ops[i].compute is None:
+                    fused.append(ops[i])
+                    i += 1
+                if fused:
+                    refs = self._run_fused_maps(fused, refs)
+                    continue
+                # actor-pool stage (not fused with task stages)
+                refs = self._run_actor_pool(op, refs)
+                i += 1
+            elif isinstance(op, AllToAll):
+                refs = self._run_all_to_all(op, refs)
+                i += 1
+            elif isinstance(op, Limit):
+                refs = self._run_limit(op, refs)
+                i += 1
+            elif isinstance(op, Union):
+                for other in op.others:
+                    refs = refs + StreamingExecutor(other).execute()
+                i += 1
+            elif isinstance(op, Zip):
+                refs = self._run_zip(op, refs)
+                i += 1
+            else:
+                raise ValueError(f"unexpected op {op}")
+        return refs
+
+    # ------------------------------------------------------------- stages
+
+    def _run_source(self, op) -> List[ObjectRef]:
+        if isinstance(op, InputData):
+            return list(op.block_refs)
+        assert isinstance(op, Read)
+        parallelism = op.parallelism if op.parallelism > 0 else \
+            max(2, int(ray_tpu.cluster_resources().get("CPU", 2)))
+        tasks = op.datasource.get_read_tasks(parallelism)
+        read = ray_tpu.remote(lambda t: t())
+        return self._bounded_submit(read, [(t,) for t in tasks])
+
+    def _run_fused_maps(self, fused: List[MapBlocks],
+                        refs: List[ObjectRef]) -> List[ObjectRef]:
+        run = ray_tpu.remote(_run_fused)
+        return self._bounded_submit(run, [(fused, r) for r in refs])
+
+    def _bounded_submit(self, remote_fn, arg_tuples) -> List[ObjectRef]:
+        """Submit with bounded in-flight work (streaming backpressure):
+        at most _MAX_IN_FLIGHT upstream tasks run at once; completed ones
+        immediately free a slot for the next."""
+        out: List[ObjectRef] = []
+        in_flight: List[ObjectRef] = []
+        for args in arg_tuples:
+            if len(in_flight) >= _MAX_IN_FLIGHT:
+                done, in_flight = ray_tpu.wait(
+                    in_flight, num_returns=1, timeout=None)
+            ref = remote_fn.remote(*args)
+            out.append(ref)
+            in_flight.append(ref)
+        return out
+
+    def _run_actor_pool(self, op: MapBlocks,
+                        refs: List[ObjectRef]) -> List[ObjectRef]:
+        from ray_tpu.core.serialization import dumps
+
+        strategy: ActorPoolStrategy = op.compute
+        import dataclasses as _dc
+
+        payload = dumps([_dc.replace(op, compute=None)])
+        pool_cls = ray_tpu.remote(_PoolWorker)
+        size = min(strategy.size, max(1, len(refs)))
+        actors = [pool_cls.options(num_cpus=strategy.num_cpus).remote(payload)
+                  for _ in range(size)]
+        out: List[ObjectRef] = []
+        # round-robin dispatch with per-actor pipelining
+        for i, r in enumerate(refs):
+            out.append(actors[i % size].apply.remote(r))
+        # results must outlive the pool: wait for completion, then kill
+        if out:
+            ray_tpu.wait(out, num_returns=len(out), timeout=None,
+                         fetch_local=False)
+        for a in actors:
+            ray_tpu.kill(a)
+        return out
+
+    def _run_all_to_all(self, op: AllToAll,
+                        refs: List[ObjectRef]) -> List[ObjectRef]:
+        kind = op.options.get("kind", op.kind)
+        n_out = op.options.get("num_blocks") or max(1, len(refs))
+        key = op.options.get("key")
+        seed = op.options.get("seed")
+        descending = op.options.get("descending", False)
+        if not refs:
+            return refs
+        if kind == "sort":
+            # phase 0: sample range boundaries (ref: data sort_op sampling)
+            sampler = ray_tpu.remote(_sample_keys)
+            samples = ray_tpu.get(
+                [sampler.remote(r, key, 20) for r in refs], timeout=600)
+            flat = sorted(x for s in samples for x in s)
+            if not flat:
+                return refs
+            step = max(1, len(flat) // n_out)
+            bounds = flat[step::step][:n_out - 1]
+            part_key = (key, bounds)
+        else:
+            part_key = key
+        splitter = ray_tpu.remote(_split_for_partition) \
+            .options(num_returns=n_out)
+        parts_by_input = []
+        for i, r in enumerate(refs):
+            s = seed if seed is None else seed + i
+            res = splitter.remote(r, n_out, kind, s, part_key)
+            parts_by_input.append(res if isinstance(res, list) else [res])
+        merge = ray_tpu.remote(_merge_parts)
+        out = []
+        for j in range(n_out):
+            ins = [parts[j] for parts in parts_by_input]
+            out.append(merge.remote(kind, key, seed, descending, *ins))
+        if kind == "sort" and descending:
+            # range partitions are ascending; descending output reverses
+            # the partition order (rows within each are already descending)
+            out.reverse()
+        return out
+
+    def _run_limit(self, op: Limit, refs: List[ObjectRef]) -> List[ObjectRef]:
+        remaining = op.n
+        out: List[ObjectRef] = []
+        slicer = ray_tpu.remote(
+            lambda b, n: BlockAccessor(b).slice(0, n))
+        counter = ray_tpu.remote(lambda b: BlockAccessor(b).num_rows())
+        for r in refs:
+            if remaining <= 0:
+                break
+            cnt = ray_tpu.get(counter.remote(r), timeout=600)
+            if cnt <= remaining:
+                out.append(r)
+                remaining -= cnt
+            else:
+                out.append(slicer.remote(r, remaining))
+                remaining = 0
+        return out
+
+    def _run_zip(self, op: Zip, refs: List[ObjectRef]) -> List[ObjectRef]:
+        other_refs = StreamingExecutor(op.other).execute()
+
+        def zip_all(*blocks):
+            half = len(blocks) // 2
+            left = BlockAccessor(BlockAccessor.concat(
+                list(blocks[:half]))).to_pylist()
+            right = BlockAccessor(BlockAccessor.concat(
+                list(blocks[half:]))).to_pylist()
+            if len(left) != len(right):
+                raise ValueError(
+                    f"zip: datasets have different counts "
+                    f"({len(left)} vs {len(right)})")
+            out = []
+            for a, b in zip(left, right):
+                row = dict(a) if isinstance(a, dict) else {"left": a}
+                if isinstance(b, dict):
+                    for k, v in b.items():
+                        row[k if k not in row else f"{k}_1"] = v
+                else:
+                    row["right"] = b
+                out.append(row)
+            return build_block(out)
+
+        z = ray_tpu.remote(zip_all)
+        return [z.remote(*refs, *other_refs)]
+
+
+def execute_plan(plan: Plan) -> List[ObjectRef]:
+    return StreamingExecutor(plan).execute()

@@ -1,0 +1,106 @@
+"""DataIterator: per-consumer streaming view of a Dataset shard.
+
+Ref analog: python/ray/data/iterator.py (DataIterator.iter_batches) and
+_internal/iterator/stream_split_iterator.py (Train ingest shards). Blocks
+are fetched lazily one at a time; batches are re-chunked to batch_size
+across block boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+from .block import BlockAccessor
+
+
+class DataIterator:
+    def __init__(self, block_refs: List[Any], name: str = "shard"):
+        self._refs = list(block_refs)
+        self._name = name
+
+    def iter_rows(self) -> Iterator[Any]:
+        for ref in self._refs:
+            block = ray_tpu.get(ref, timeout=600)
+            yield from BlockAccessor(block).iter_rows()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     seed: Optional[int] = None) -> Iterator[Any]:
+        """Re-chunk rows into batches of exactly batch_size (except possibly
+        the last). With local_shuffle_buffer_size, rows pass through a
+        shuffle buffer first (ref: iter_batches local shuffle)."""
+        rng = np.random.default_rng(seed)
+        buf: List[Any] = []
+        shuffle_n = local_shuffle_buffer_size or 0
+
+        threshold = batch_size + shuffle_n
+
+        def emit_ready():
+            while len(buf) >= threshold:
+                if shuffle_n:
+                    idx = rng.choice(len(buf), size=batch_size,
+                                     replace=False)
+                    idx_set = set(int(i) for i in idx)
+                    batch = [buf[i] for i in idx_set]
+                    rest = [r for i, r in enumerate(buf)
+                            if i not in idx_set]
+                    buf[:] = rest
+                else:
+                    batch, buf[:] = buf[:batch_size], buf[batch_size:]
+                yield _rows_to_batch(batch, batch_format)
+
+        for ref in self._refs:
+            block = ray_tpu.get(ref, timeout=600)
+            buf.extend(BlockAccessor(block).iter_rows())
+            yield from emit_ready()
+        while buf and (len(buf) >= batch_size or not drop_last):
+            batch, buf = buf[:batch_size], buf[batch_size:]
+            yield _rows_to_batch(batch, batch_format)
+
+    def iter_jax_batches(self, *, batch_size: int = 256, sharding=None,
+                         dtype=None, **kw) -> Iterator[Dict[str, Any]]:
+        """Numpy batches placed onto device (optionally with a NamedSharding
+        for pjit consumption) — the TPU-native analog of
+        iter_torch_batches."""
+        import jax
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy", **kw):
+            if dtype is not None:
+                batch = {k: v.astype(dtype) if np.issubdtype(
+                    v.dtype, np.floating) else v
+                    for k, v in batch.items()}
+            if sharding is not None:
+                batch = {k: jax.device_put(v, sharding)
+                         for k, v in batch.items()}
+            else:
+                batch = {k: jax.device_put(v) for k, v in batch.items()}
+            yield batch
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           **kw) -> Iterator[Dict[str, Any]]:
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy", **kw):
+            yield {k: torch.as_tensor(v) for k, v in batch.items()}
+
+    def materialize(self):
+        from .dataset import Dataset, _plan_from_refs
+
+        return Dataset(_plan_from_refs(self._refs))
+
+    def stats(self) -> str:
+        return f"DataIterator({self._name}, {len(self._refs)} blocks)"
+
+
+def _rows_to_batch(rows: List[Any], batch_format: str):
+    from .block import build_block
+
+    return BlockAccessor(build_block(rows)).to_batch(batch_format)

@@ -257,3 +257,55 @@ class TestObjects:
     def test_cluster_resources(self, rt):
         res = ray_tpu.cluster_resources()
         assert res["CPU"] == 4.0
+
+
+class TestTaskChaining:
+    """Submitter-side dependency resolution (regression: tasks whose args
+    were pending upstream outputs hung forever — the inline result was never
+    promoted to shm for the downstream worker)."""
+
+    def test_pending_output_as_arg(self, rt):
+        @ray_tpu.remote
+        def inc(x):
+            return x + 1
+
+        refs = [inc.remote(i) for i in range(8)]
+        chained = [inc.remote(r) for r in refs]
+        assert ray_tpu.get(chained, timeout=60) == list(range(2, 10))
+
+    def test_deep_chain(self, rt):
+        @ray_tpu.remote
+        def inc(x):
+            return x + 1
+
+        r = inc.remote(0)
+        for _ in range(10):
+            r = inc.remote(r)
+        assert ray_tpu.get(r, timeout=60) == 11
+
+    def test_error_propagates_to_dependents(self, rt):
+        @ray_tpu.remote(max_retries=0)
+        def boom():
+            raise ValueError("chained-err")
+
+        @ray_tpu.remote
+        def inc(x):
+            return x + 1
+
+        with pytest.raises(ray_tpu.RayTaskError) as ei:
+            ray_tpu.get(inc.remote(boom.remote()), timeout=60)
+        assert "chained-err" in str(ei.value)
+
+    def test_actor_method_with_pending_arg(self, rt):
+        @ray_tpu.remote
+        def slow(x):
+            time.sleep(0.5)
+            return x
+
+        @ray_tpu.remote
+        class Doubler:
+            def use(self, v):
+                return v * 2
+
+        d = Doubler.remote()
+        assert ray_tpu.get(d.use.remote(slow.remote(21)), timeout=60) == 42
