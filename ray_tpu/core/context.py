@@ -117,7 +117,10 @@ class CoreContext:
         self.is_driver = is_driver
         self.worker_id = worker_id or WorkerID.from_random().hex()
         self.job_id = job_id or JobID.from_int(1)
-        self.current_task_id = TaskID.for_driver(self.job_id)
+        # thread-local: threaded actors (max_concurrency > 1) execute tasks
+        # concurrently, and put() stamps ObjectIDs with the current task id
+        self._task_tls = threading.local()
+        self._default_task_id = TaskID.for_driver(self.job_id)
         self._put_index = itertools.count(1)
 
         self.memory_store = MemoryStore()
@@ -244,6 +247,14 @@ class CoreContext:
         return self.head.call(P.KV_KEYS, ns, prefix, timeout=30)[0]
 
     # ================================================== put / get / wait
+
+    @property
+    def current_task_id(self):
+        return getattr(self._task_tls, "task_id", self._default_task_id)
+
+    @current_task_id.setter
+    def current_task_id(self, tid):
+        self._task_tls.task_id = tid
 
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(self.current_task_id, next(self._put_index))
@@ -992,7 +1003,14 @@ class CoreContext:
     # ================================================== executor (workers)
 
     def run_executor(self):
-        """Worker main loop: execute pushed tasks until shutdown."""
+        """Worker main loop: execute pushed tasks until shutdown.
+
+        Actors created with ``max_concurrency > 1`` (the reference's threaded
+        actors, core_worker concurrency groups) run their method calls on a
+        thread pool of that size; everything else executes inline, in push
+        order.
+        """
+        pool = None
         while not self._shutdown:
             try:
                 item = self._exec_queue.get(timeout=1.0)
@@ -1001,12 +1019,35 @@ class CoreContext:
             if item is None:
                 break
             spec, conn = item
-            try:
-                self._execute(spec, conn)
-            except P.ConnectionLost:
-                pass
-            except Exception:
-                traceback.print_exc()
+            aspec = self._actor_spec
+            if (aspec is not None and aspec.max_concurrency > 1
+                    and spec.task_type == TaskType.ACTOR_TASK
+                    and spec.method_name != "__ray_terminate__"):
+                if pool is None:
+                    import concurrent.futures as cf
+
+                    pool = cf.ThreadPoolExecutor(
+                        max_workers=aspec.max_concurrency,
+                        thread_name_prefix="actor-exec")
+                pool.submit(self._execute_safe, spec, conn)
+            else:
+                if pool is not None and spec.method_name == \
+                        "__ray_terminate__":
+                    # Drain in-flight pooled tasks before _graceful_exit's
+                    # os._exit — otherwise their callers see 'worker died'
+                    # instead of results (same semantics as serial actors,
+                    # where terminate queues behind pending tasks).
+                    pool.shutdown(wait=True)
+                    pool = None
+                self._execute_safe(spec, conn)
+
+    def _execute_safe(self, spec: TaskSpec, conn: P.Connection):
+        try:
+            self._execute(spec, conn)
+        except P.ConnectionLost:
+            pass
+        except Exception:
+            traceback.print_exc()
 
     def _decode_args(self, spec: TaskSpec):
         vals = []

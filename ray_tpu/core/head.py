@@ -50,6 +50,7 @@ class WorkerInfo:
     lease_id: Optional[str] = None
     actor_id: Optional[ActorID] = None
     idle_since: float = 0.0
+    spawned_at: float = 0.0
 
 
 @dataclass
@@ -270,10 +271,14 @@ class Head:
             granted = False
             with self._lock:
                 pending = list(self._pending_leases)
+            demand: dict = {}
+            for item in pending:
+                demand[item[2]] = demand.get(item[2], 0) + 1
             for item in pending:
                 conn, rid, sched_class, request, job_hex, strategy_bytes = item
                 strategy: SchedulingStrategy = loads(strategy_bytes)
-                grant = self._try_grant(sched_class, request, strategy)
+                grant = self._try_grant(sched_class, request, strategy,
+                                        demand=demand.get(sched_class, 1))
                 if grant is None:
                     continue
                 with self._lock:
@@ -290,10 +295,17 @@ class Head:
             if not granted:
                 return
 
-    def _try_grant(self, sched_class, request: ResourceSet, strategy
-                   ) -> Optional[Tuple[object, str]]:
+    def _try_grant(self, sched_class, request: ResourceSet, strategy,
+                   demand: int = 1) -> Optional[Tuple[object, str]]:
         """Try to allocate resources + a worker. Returns (WorkerInfo, lease)
-        or ("spawning", "") if a worker is being started, or None."""
+        or ("spawning", "") if a worker is being started, or None.
+
+        ``demand`` caps the spawn stampede: if at least that many workers of
+        any class are already starting on the node, no new process is forked
+        (the round-1 bug was the actor-creation retry timer forking a fresh
+        interpreter every 50ms, starving the CPU so *no* worker ever finished
+        importing; ref: WorkerPool pending-registration accounting,
+        src/ray/raylet/worker_pool.cc)."""
         with self._lock:
             pg_id = strategy.placement_group_id
             if pg_id is not None:
@@ -338,8 +350,14 @@ class Head:
                     self.leases[lease_id] = (node_idx, request, wid,
                                              self.leases[lease_id][3])
                     return w, lease_id
-            # spawn a new worker, re-queue the lease until it registers
-            self._spawn_worker(node, sched_class)
+            # spawn a new worker (unless enough are already starting),
+            # re-queue the lease until it registers
+            now = time.monotonic()
+            starting = sum(1 for w in node.workers.values()
+                           if w.state == "starting"
+                           and now - w.spawned_at < 60.0)
+            if starting < demand:
+                self._spawn_worker(node, sched_class)
             # roll back allocation; the pending lease will re-acquire
             if pg_id is not None:
                 self._pg_release(pg_id, strategy.bundle_index, request)
@@ -355,18 +373,26 @@ class Head:
             return None  # type: ignore[return-value]
         worker_id = uuid.uuid4().hex
         w = WorkerInfo(worker_id=worker_id, node_idx=node.idx,
-                       sched_class=sched_class)
+                       sched_class=sched_class,
+                       spawned_at=time.monotonic())
         node.workers[worker_id] = w
         env = dict(os.environ)
-        # Workers must find the ray_tpu package regardless of driver cwd.
+        # Ship the driver's full sys.path to workers (the reference does the
+        # same via its runtime env / worker setup, worker.py): functions and
+        # classes pickled *by reference* (module-level defs, e.g. in pytest
+        # test modules whose dir pytest inserted into sys.path) must be
+        # importable where they execute.
         import ray_tpu
 
         pkg_parent = os.path.dirname(os.path.dirname(
             os.path.abspath(ray_tpu.__file__)))
         pp = env.get("PYTHONPATH", "")
-        if pkg_parent not in pp.split(os.pathsep):
-            env["PYTHONPATH"] = (pkg_parent + os.pathsep + pp) if pp \
-                else pkg_parent
+        entries = [p for p in sys.path if p] + [pkg_parent]
+        have = set(pp.split(os.pathsep)) if pp else set()
+        add = [p for p in entries if p not in have]
+        if add:
+            env["PYTHONPATH"] = os.pathsep.join(
+                add + ([pp] if pp else []))
         env.update({
             "RAY_TPU_WORKER_ID": worker_id,
             "RAY_TPU_HEAD_ADDR": self.addr,
@@ -504,6 +530,7 @@ class Head:
             if status != "ok":
                 info.state = "DEAD"
                 info.death_cause = f"creation failed: {err}"
+                self._release_actor_name(info)
                 waiters = list(info.pending_get_replies)
                 info.pending_get_replies.clear()
                 state, payload = "DEAD", info.death_cause
@@ -538,6 +565,7 @@ class Head:
             else:
                 info.state = "DEAD"
                 info.death_cause = "worker died"
+                self._release_actor_name(info)
         if info.state == "RESTARTING":
             self._publish(f"actor:{actor_id.hex()}", dumps(("RESTARTING", "")))
             self._schedule_actor(info)
@@ -551,15 +579,29 @@ class Head:
             info.death_cause = cause
             waiters = list(info.pending_get_replies)
             info.pending_get_replies.clear()
+            self._release_actor_name(info)
         for wconn, wrid in waiters:
             wconn.reply(wrid, "DEAD", cause, msg_type=P.GET_ACTOR_REPLY)
         self._publish(f"actor:{info.actor_id.hex()}", dumps(("DEAD", cause)))
+
+    def _release_actor_name(self, info: ActorInfo):
+        """Free a dead actor's name for reuse (head tables + KV mirror).
+
+        The reference's GcsActorManager does the same on actor death
+        (gcs_actor_manager.cc RemoveActorNameFromRegistry). Caller holds
+        the lock."""
+        if info.name and self.named_actors.get(info.name) == info.actor_id:
+            del self.named_actors[info.name]
+            self.kv.get("named_actor", {}).pop(info.name, None)
 
     def _h_get_actor(self, conn, rid, actor_id_bin_or_name):
         with self._lock:
             if isinstance(actor_id_bin_or_name, str):
                 aid = self.named_actors.get(actor_id_bin_or_name)
-                if aid is None:
+                dead = aid is not None and (
+                    self.actors.get(aid) is None
+                    or self.actors[aid].state == "DEAD")
+                if aid is None or dead:
                     conn.reply(rid, "NOT_FOUND", "",
                                msg_type=P.GET_ACTOR_REPLY)
                     return
@@ -590,6 +632,7 @@ class Head:
                 info.spec.max_restarts = 0
                 info.state = "DEAD"
                 info.death_cause = "killed via kill()"
+                self._release_actor_name(info)
             node = self.nodes.get(
                 next((n.idx for n in self.nodes.values()
                       if info.worker_id in n.workers), -1))

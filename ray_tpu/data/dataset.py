@@ -111,15 +111,14 @@ class Dataset:
 
     def random_sample(self, fraction: float,
                       *, seed: Optional[int] = None) -> "Dataset":
-        import random as _random
-
+        # Executed as a dedicated block op seeded by (seed, block index):
+        # a per-task Random(seed) would replay the identical sequence in
+        # every block (the closure is re-unpickled per worker), correlating
+        # draws across blocks (round-1 ADVICE, low).
         rng_seed = seed if seed is not None else int(time.time())
-
-        def sample(row, _rng={}):
-            r = _rng.setdefault("r", _random.Random(rng_seed))
-            return r.random() < fraction
-
-        return self.filter(sample)
+        return self._with_op(MapBlocks(
+            name=f"random_sample({fraction})", kind="random_sample",
+            fn=(fraction, rng_seed)))
 
     # --------------------------------------------------------- consumption
 

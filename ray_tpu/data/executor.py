@@ -29,9 +29,17 @@ _MAX_IN_FLIGHT = 16
 # ------------------------------------------------------------ fused mapper
 
 
-def _apply_one(op: MapBlocks, block):
+def _apply_one(op: MapBlocks, block, block_idx: int = 0):
     acc = BlockAccessor(block)
     kind, fn = op.kind, op.fn
+    if kind == "random_sample":
+        # Per-block RNG seeded by (seed, block index): deterministic,
+        # independent across blocks, and insensitive to row content (a
+        # content hash would correlate duplicate rows — round-2 review).
+        fraction, seed = fn
+        rng = random.Random((seed, block_idx))
+        return build_block([r for r in acc.iter_rows()
+                            if rng.random() < fraction])
     if kind == "map_batches":
         out_blocks = []
         n = acc.num_rows()
@@ -71,10 +79,10 @@ def _apply_one(op: MapBlocks, block):
     raise ValueError(f"unknown map kind {kind}")
 
 
-def _run_fused(ops: List[MapBlocks], block):
+def _run_fused(ops: List[MapBlocks], block, block_idx: int = 0):
     for op in ops:
         op = _instantiate(op)
-        block = _apply_one(op, block)
+        block = _apply_one(op, block, block_idx)
     return block
 
 
@@ -99,9 +107,9 @@ class _PoolWorker:
         ops = loads(ops_payload)
         self._ops = [_instantiate(op) for op in ops]
 
-    def apply(self, block):
+    def apply(self, block, block_idx: int = 0):
         for op in self._ops:
-            block = _apply_one(op, block)
+            block = _apply_one(op, block, block_idx)
         return block
 
 
@@ -129,10 +137,24 @@ def _split_for_partition(block, n: int, kind: str, seed, key):
             parts[idx].append(r)
     elif kind == "groupby":
         for r in rows:
-            parts[hash(_key_of(r, key)) % n].append(r)
+            parts[_det_hash(_key_of(r, key)) % n].append(r)
     else:
         raise ValueError(kind)
     return tuple(build_block(p) for p in parts)
+
+
+def _det_hash(value) -> int:
+    """Deterministic cross-process hash for exchange partitioning.
+
+    Python's builtin hash() is salted per process (PYTHONHASHSEED), so two
+    workers would route the same key to different partitions — silently
+    duplicating groups (round-1 ADVICE, high). crc32 over the pickled key is
+    stable across interpreters for the plain-data keys groupby supports.
+    """
+    import pickle
+    import zlib
+
+    return zlib.crc32(pickle.dumps(value, protocol=4))
 
 
 def _key_of(row, key):
@@ -222,7 +244,8 @@ class StreamingExecutor:
     def _run_fused_maps(self, fused: List[MapBlocks],
                         refs: List[ObjectRef]) -> List[ObjectRef]:
         run = ray_tpu.remote(_run_fused)
-        return self._bounded_submit(run, [(fused, r) for r in refs])
+        return self._bounded_submit(
+            run, [(fused, r, i) for i, r in enumerate(refs)])
 
     def _bounded_submit(self, remote_fn, arg_tuples) -> List[ObjectRef]:
         """Submit with bounded in-flight work (streaming backpressure):
@@ -254,7 +277,7 @@ class StreamingExecutor:
         out: List[ObjectRef] = []
         # round-robin dispatch with per-actor pipelining
         for i, r in enumerate(refs):
-            out.append(actors[i % size].apply.remote(r))
+            out.append(actors[i % size].apply.remote(r, i))
         # results must outlive the pool: wait for completion, then kill
         if out:
             ray_tpu.wait(out, num_returns=len(out), timeout=None,
@@ -324,12 +347,14 @@ class StreamingExecutor:
     def _run_zip(self, op: Zip, refs: List[ObjectRef]) -> List[ObjectRef]:
         other_refs = StreamingExecutor(op.other).execute()
 
-        def zip_all(*blocks):
-            half = len(blocks) // 2
+        def zip_all(n_left, *blocks):
+            # n_left is passed explicitly: the two sides may have different
+            # block counts, so halving len(blocks) mis-assigns blocks
+            # (round-1 ADVICE, medium).
             left = BlockAccessor(BlockAccessor.concat(
-                list(blocks[:half]))).to_pylist()
+                list(blocks[:n_left]))).to_pylist()
             right = BlockAccessor(BlockAccessor.concat(
-                list(blocks[half:]))).to_pylist()
+                list(blocks[n_left:]))).to_pylist()
             if len(left) != len(right):
                 raise ValueError(
                     f"zip: datasets have different counts "
@@ -346,7 +371,7 @@ class StreamingExecutor:
             return build_block(out)
 
         z = ray_tpu.remote(zip_all)
-        return [z.remote(*refs, *other_refs)]
+        return [z.remote(len(refs), *refs, *other_refs)]
 
 
 def execute_plan(plan: Plan) -> List[ObjectRef]:

@@ -81,6 +81,15 @@ def gpt2_small_config(**kw) -> TransformerConfig:
     return TransformerConfig(**base)
 
 
+def llama3_1b_config(**kw) -> TransformerConfig:
+    """~1.2B-param Llama-3.2-1B-class geometry; single-chip bench flagship."""
+    base = dict(vocab_size=128_256, d_model=2048, n_layers=16, n_heads=32,
+                n_kv_heads=8, d_ff=8192, max_seq_len=4096,
+                rope_theta=500_000.0, tie_embeddings=True)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
 def llama3_8b_config(**kw) -> TransformerConfig:
     """Llama-3-8B geometry (the north-star pretrain target)."""
     base = dict(vocab_size=128_256, d_model=4096, n_layers=32, n_heads=32,
@@ -100,6 +109,7 @@ def llama3_70b_config(**kw) -> TransformerConfig:
 PRESETS = {
     "tiny": tiny_config,
     "gpt2-small": gpt2_small_config,
+    "llama3-1b": llama3_1b_config,
     "llama3-8b": llama3_8b_config,
     "llama3-70b": llama3_70b_config,
 }

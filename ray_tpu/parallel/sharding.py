@@ -97,7 +97,11 @@ def _current_mesh() -> Optional[Mesh]:
     except Exception:
         pass
     try:
-        env_mesh = jax.interpreters.pxla.thread_resources.env.physical_mesh
+        # jax.interpreters.pxla.thread_resources is deprecated; the private
+        # mesh_lib path is the non-deprecated home of the same thread-local.
+        from jax._src import mesh as _mesh_lib
+
+        env_mesh = _mesh_lib.thread_resources.env.physical_mesh
         if env_mesh is not None and not env_mesh.empty:
             return env_mesh
     except Exception:

@@ -1,0 +1,40 @@
+"""ray_tpu.serve — model serving on the ray_tpu runtime.
+
+TPU-first re-design of the reference's Serve library (SURVEY.md §2.4;
+python/ray/serve/): a controller actor reconciles declarative app state
+into replica actors; handles route requests client-side with
+power-of-two-choices + max_concurrent_queries backpressure;
+``@serve.batch`` coalesces concurrent requests into one XLA forward pass;
+an HTTP proxy actor provides ingress. Deployments may request TPU chips
+via ``ray_actor_options={"num_tpus": N}``.
+
+Public API mirrors ``ray.serve``:
+
+    @serve.deployment
+    class Model:
+        def __call__(self, x): ...
+
+    handle = serve.run(Model.bind(), name="app")
+    handle.remote(x).result()
+"""
+
+from .api import (
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from .batching import batch
+from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from .deployment import Application, Deployment, deployment
+from .handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "deployment", "Deployment", "Application", "run", "delete", "status",
+    "shutdown", "start", "batch", "get_app_handle", "get_deployment_handle",
+    "DeploymentHandle", "DeploymentResponse", "AutoscalingConfig",
+    "DeploymentConfig", "HTTPOptions",
+]

@@ -1,0 +1,207 @@
+"""Serve public API: run/status/delete/shutdown + handles + HTTP start.
+
+Ref analogs: python/ray/serve/api.py:437 (serve.run), :243 (@serve
+.deployment via deployment.py), serve/controller.py:696 (declarative
+deploy_apps). The application graph is walked here: every Application found
+in a bound deployment's init args is deployed into the same app and replaced
+by a HandleMarker that the replica rehydrates into a DeploymentHandle.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.serialization import dumps
+
+from .config import HTTPOptions
+from .controller import (
+    CONTROLLER_NAME,
+    DEPLOY_HEALTHY,
+    get_or_create_controller,
+)
+from .deployment import Application, Deployment
+from .handle import DeploymentHandle
+from .http_proxy import HTTPProxy, PROXY_NAME
+
+
+def _collect_app(app: Application) -> List[dict]:
+    """Flatten the application graph into replica-spec payloads."""
+    import inspect
+
+    from .replica import HandleMarker
+
+    out: Dict[str, dict] = {}
+
+    def mark(obj, app_name: str):
+        if isinstance(obj, Application):
+            visit(obj, app_name)
+            return HandleMarker(obj.deployment.name, app_name)
+        if isinstance(obj, Deployment):
+            raise TypeError(
+                f"pass '{obj.name}.bind(...)' (an Application), not the "
+                f"bare Deployment, as an init arg")
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(mark(x, app_name) for x in obj)
+        if isinstance(obj, dict):
+            return {k: mark(v, app_name) for k, v in obj.items()}
+        return obj
+
+    def visit(node: Application, app_name: str):
+        dep = node.deployment
+        if dep.name in out:
+            return
+        out[dep.name] = {}  # reserve before recursing (cycle guard)
+        init_args = tuple(mark(a, app_name) for a in node.init_args)
+        init_kwargs = {k: mark(v, app_name)
+                       for k, v in node.init_kwargs.items()}
+        spec = {
+            "func_or_class": dep.func_or_class,
+            "is_function": not inspect.isclass(dep.func_or_class),
+            "init_args": init_args,
+            "init_kwargs": init_kwargs,
+            "user_config": dep.config.user_config,
+        }
+        out[dep.name] = {"name": dep.name, "payload": dumps(spec),
+                         "config": dep.config}
+
+    # app_name resolved by caller; placeholder substituted there
+    visit(app, "__APP__")
+    return list(out.values())
+
+
+def run(target: Application, *, name: str = "default",
+        route_prefix: Optional[str] = "/", _blocking: bool = True,
+        timeout_s: float = 60.0) -> DeploymentHandle:
+    """Deploy an application and return a handle to its ingress."""
+    if isinstance(target, Deployment):
+        target = target.bind()
+    if not isinstance(target, Application):
+        raise TypeError(f"serve.run expects an Application, got {target}")
+    ctrl = get_or_create_controller()
+
+    # markers live inside pickled payloads; rebuild with the real app name
+    from ray_tpu.core.serialization import loads
+
+    from .replica import HandleMarker
+
+    def walk(o):
+        if isinstance(o, HandleMarker):
+            if o.app_name == "__APP__":
+                o.app_name = name
+            return o
+        if isinstance(o, (list, tuple)):
+            return type(o)(walk(x) for x in o)
+        if isinstance(o, dict):
+            return {k: walk(v) for k, v in o.items()}
+        return o
+
+    deployments = []
+    for d in _collect_app(target):
+        spec = loads(d["payload"])
+        spec["init_args"] = walk(spec["init_args"])
+        spec["init_kwargs"] = walk(spec["init_kwargs"])
+        deployments.append({"name": d["name"], "payload": dumps(spec),
+                            "config": d["config"]})
+
+    ray_tpu.get(ctrl.deploy_app.remote(
+        name, route_prefix, target.deployment.name, deployments),
+        timeout=30)
+
+    from .router import reset_routers
+
+    reset_routers()
+
+    if _blocking:
+        _wait_healthy(ctrl, name, timeout_s)
+    return DeploymentHandle(target.deployment.name, name)
+
+
+def _wait_healthy(ctrl, app_name: str, timeout_s: float):
+    deadline = time.monotonic() + timeout_s
+    last = {}
+    while time.monotonic() < deadline:
+        last = ray_tpu.get(ctrl.status.remote(), timeout=30)
+        app = last.get(app_name, {})
+        if app.get("status") == "RUNNING":
+            return
+        if app.get("status") == "UNHEALTHY":
+            msgs = {d: s.get("message") for d, s in
+                    app.get("deployments", {}).items()
+                    if s.get("status") != DEPLOY_HEALTHY}
+            raise RuntimeError(f"app '{app_name}' unhealthy: {msgs}")
+        time.sleep(0.05)
+    raise TimeoutError(
+        f"app '{app_name}' not healthy after {timeout_s}s: {last}")
+
+
+def status() -> dict:
+    try:
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return {"applications": {}}
+    return {"applications": ray_tpu.get(ctrl.status.remote(), timeout=30)}
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+    ingress = ray_tpu.get(ctrl.get_ingress.remote(name), timeout=30)
+    if ingress is None:
+        raise ValueError(f"no serve application named '{name}'")
+    return DeploymentHandle(ingress, name)
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def delete(name: str, _blocking: bool = True):
+    try:
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return
+    ray_tpu.get(ctrl.delete_app.remote(name), timeout=30)
+    from .router import reset_routers
+
+    reset_routers()
+
+
+def start(http_options: Optional[HTTPOptions] = None) -> int:
+    """Start the HTTP proxy (idempotent); returns the bound port."""
+    get_or_create_controller()
+    http_options = http_options or HTTPOptions()
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME)
+    except ValueError:
+        proxy = ray_tpu.remote(HTTPProxy).options(
+            name=PROXY_NAME, num_cpus=0, max_concurrency=32).remote(
+                http_options.host, http_options.port)
+    return ray_tpu.get(proxy.port.remote(), timeout=30)
+
+
+def shutdown():
+    """Tear down all applications, the proxy, and the controller."""
+    from .router import reset_routers
+
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME)
+        try:
+            ray_tpu.get(proxy.stop.remote(), timeout=10)
+        except Exception:
+            pass
+        ray_tpu.kill(proxy)
+    except ValueError:
+        pass
+    try:
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        reset_routers()
+        return
+    try:
+        ray_tpu.get(ctrl.shutdown_serve.remote(), timeout=30)
+    except Exception:
+        pass
+    ray_tpu.kill(ctrl)
+    reset_routers()

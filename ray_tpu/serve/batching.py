@@ -1,0 +1,103 @@
+"""``@serve.batch`` — coalesce concurrent single calls into one batch call.
+
+Ref analog: python/ray/serve/batching.py:337 (@serve.batch, asyncio-queue
+based). Re-design for threaded replicas: callers land on the replica's
+thread pool; the first caller in a window becomes the *leader*, waits up to
+``batch_wait_timeout_s`` (cut short the moment the batch fills), then runs
+the wrapped function once on the whole batch while the other callers block
+on their per-item futures. This is how an XLA-compiled model replica turns
+N concurrent requests into one padded forward pass.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import functools
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+# per-process registry of batchers for plain-function @serve.batch targets
+_global_batchers: Dict[Any, "_Batcher"] = {}
+
+
+class _Batcher:
+    def __init__(self, max_batch_size: int, batch_wait_timeout_s: float):
+        self.max_bs = max_batch_size
+        self.wait_s = batch_wait_timeout_s
+        self.lock = threading.Lock()
+        self.full = threading.Event()
+        self.queue: List = []  # (item, Future)
+
+    def submit(self, call_batch: Callable[[list], list], item: Any) -> Any:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        with self.lock:
+            leader = not self.queue
+            self.queue.append((item, fut))
+            if leader:
+                self.full.clear()
+            if len(self.queue) >= self.max_bs:
+                self.full.set()
+        if leader:
+            self.full.wait(self.wait_s)
+            with self.lock:
+                batch, self.queue = self.queue, []
+            items = [i for i, _ in batch]
+            try:
+                results = call_batch(items)
+                if results is None or len(results) != len(items):
+                    raise ValueError(
+                        f"@serve.batch function must return one result per "
+                        f"input ({len(items)} in, "
+                        f"{len(results) if results is not None else 0} out)")
+            except Exception as e:  # noqa: BLE001 — propagate to all callers
+                for _, f in batch:
+                    f.set_exception(e)
+                raise
+            for (_, f), r in zip(batch, results):
+                f.set_result(r)
+        return fut.result()
+
+
+def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorate a function/method taking a list so single calls batch up.
+
+    The wrapped callable must accept a list of items and return a list of
+    results of the same length. Call sites pass ONE item and get ONE result.
+    """
+
+    def decorate(fn):
+        attr = f"__serve_batcher_{fn.__name__}"
+
+        @functools.wraps(fn)
+        def wrapper(*args):
+            # Batchers hold locks, so they are created lazily per process
+            # and never captured in the closure — the deployment payload is
+            # pickled by value and locks don't pickle.
+            if len(args) == 2:  # bound method: (self, item)
+                self_, item = args
+                batcher = getattr(self_, attr, None)
+                if batcher is None:
+                    # created once under a racy-but-idempotent setattr
+                    # (worst case one extra object)
+                    batcher = _Batcher(max_batch_size, batch_wait_timeout_s)
+                    if not hasattr(self_, attr):
+                        setattr(self_, attr, batcher)
+                    batcher = getattr(self_, attr)
+                return batcher.submit(lambda items: fn(self_, items), item)
+            if len(args) == 1:
+                batcher = _global_batchers.get(wrapper)
+                if batcher is None:
+                    batcher = _global_batchers.setdefault(
+                        wrapper, _Batcher(max_batch_size,
+                                          batch_wait_timeout_s))
+                return batcher.submit(lambda items: fn(items), args[0])
+            raise TypeError(
+                "@serve.batch functions take exactly one item argument")
+
+        wrapper._is_serve_batch = True  # noqa: SLF001
+        return wrapper
+
+    if _func is not None:
+        return decorate(_func)
+    return decorate

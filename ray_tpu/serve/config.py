@@ -1,0 +1,59 @@
+"""Serve configuration dataclasses.
+
+Ref analogs: python/ray/serve/config.py (DeploymentConfig, AutoscalingConfig,
+HTTPOptions) and python/ray/serve/schema.py:326 — re-designed as plain
+dataclasses; TPU replicas declare ``num_tpus`` in ``ray_actor_options``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Request-based autoscaling (ref: _private/autoscaling_policy.py:106)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_num_ongoing_requests_per_replica: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+    # exponential smoothing applied to the raw desired-replica estimate
+    smoothing_factor: float = 1.0
+
+    def __post_init__(self):
+        if self.min_replicas < 0 or self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"invalid autoscaling bounds [{self.min_replicas}, "
+                f"{self.max_replicas}]")
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_concurrent_queries: int = 8
+    user_config: Any = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    health_check_period_s: float = 1.0
+    health_check_timeout_s: float = 10.0
+    graceful_shutdown_timeout_s: float = 5.0
+    version: Optional[str] = None
+
+
+@dataclasses.dataclass
+class HTTPOptions:
+    host: str = "127.0.0.1"
+    port: int = 8000
+
+
+@dataclasses.dataclass
+class ReplicaMetrics:
+    """What a replica reports to the controller each health-check tick."""
+
+    replica_id: str = ""
+    num_ongoing_requests: int = 0
+    num_completed_requests: int = 0
+    healthy: bool = True

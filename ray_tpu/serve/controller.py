@@ -1,0 +1,453 @@
+"""Serve controller: singleton actor reconciling target vs actual state.
+
+Ref analogs: python/ray/serve/controller.py:82 (ServeController),
+_private/deployment_state.py:1140 (DeploymentState reconciler),
+_private/application_state.py, _private/autoscaling_policy.py:106.
+
+Re-design: one actor, one background reconcile thread, non-blocking
+polling of replica ping/metrics futures via ``wait(timeout=0)`` — no
+asyncio control loop, no long-poll broker. Routers poll the controller's
+monotonically increasing ``routing_version`` and refresh membership on
+change (cheap: a version int + a handle list per deployment).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.serialization import dumps
+
+from .config import AutoscalingConfig, DeploymentConfig
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+STARTING = "STARTING"
+RUNNING = "RUNNING"
+STOPPING = "STOPPING"
+
+# deployment-level statuses (ref: serve/_private/common.py DeploymentStatus)
+DEPLOY_UPDATING = "UPDATING"
+DEPLOY_HEALTHY = "HEALTHY"
+DEPLOY_UNHEALTHY = "UNHEALTHY"
+
+_TICK_S = 0.05
+_MAX_CONSECUTIVE_START_FAILURES = 3
+
+
+class _Replica:
+    def __init__(self, replica_id: str, handle, version: str):
+        self.replica_id = replica_id
+        self.handle = handle
+        self.version = version
+        self.state = STARTING
+        self.started_at = time.monotonic()
+        self.ping_ref = None
+        self.metrics_ref = None
+        self.ongoing = 0
+        self.last_seen = time.monotonic()
+
+
+class _DeploymentState:
+    def __init__(self, app: str, name: str, payload: bytes,
+                 config: DeploymentConfig, version: str):
+        self.app = app
+        self.name = name
+        self.payload = payload
+        self.config = config
+        self.version = version
+        self.replicas: List[_Replica] = []
+        self.status = DEPLOY_UPDATING
+        self.message = ""
+        self.start_failures = 0
+        self.next_replica_idx = 0
+        # autoscaling state
+        self.autoscale_desired = config.num_replicas
+        self._above_since: Optional[float] = None
+        self._below_since: Optional[float] = None
+
+    # ----- helpers
+
+    def target_replicas(self) -> int:
+        if self.config.autoscaling_config is not None:
+            return self.autoscale_desired
+        return self.config.num_replicas
+
+    def running(self, version: Optional[str] = None) -> List[_Replica]:
+        return [r for r in self.replicas
+                if r.state == RUNNING and
+                (version is None or r.version == version)]
+
+
+class ServeController:
+    """The singleton controller actor (create with max_concurrency >= 4)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        # app -> {"route_prefix", "ingress", "deployments": {name: state}}
+        self._apps: Dict[str, dict] = {}
+        self._routing_version = 0
+        self._shutdown = False
+        self._thread = threading.Thread(target=self._control_loop,
+                                        daemon=True, name="serve-reconcile")
+        self._thread.start()
+
+    # ================================================= declarative API
+
+    def deploy_app(self, app_name: str, route_prefix: Optional[str],
+                   ingress: str, deployments: List[dict]):
+        """Set the target state for one application (idempotent).
+
+        ``deployments``: [{name, payload, config}] — payload is the pickled
+        replica spec (callable + init args with HandleMarkers).
+        """
+        with self._lock:
+            app = self._apps.setdefault(
+                app_name, {"route_prefix": None, "ingress": ingress,
+                           "deployments": {}})
+            app["route_prefix"] = route_prefix
+            app["ingress"] = ingress
+            new_names = set()
+            for d in deployments:
+                name, payload, config = d["name"], d["payload"], d["config"]
+                version = config.version or \
+                    hashlib.sha1(payload).hexdigest()[:12]
+                new_names.add(name)
+                cur = app["deployments"].get(name)
+                if cur is None:
+                    app["deployments"][name] = _DeploymentState(
+                        app_name, name, payload, config, version)
+                else:
+                    cur.payload = payload
+                    cur.config = config
+                    cur.version = version
+                    cur.status = DEPLOY_UPDATING
+                    cur.start_failures = 0
+                    if config.autoscaling_config is not None:
+                        lo = config.autoscaling_config.min_replicas
+                        hi = config.autoscaling_config.max_replicas
+                        cur.autoscale_desired = min(
+                            max(cur.autoscale_desired, lo), hi)
+                    else:
+                        cur.autoscale_desired = config.num_replicas
+            # deployments removed from the app spec are torn down
+            for name in list(app["deployments"]):
+                if name not in new_names:
+                    self._teardown_deployment(app["deployments"].pop(name))
+            self._routing_version += 1
+        return True
+
+    def delete_app(self, app_name: str):
+        with self._lock:
+            app = self._apps.pop(app_name, None)
+            if app is None:
+                return False
+            for dep in app["deployments"].values():
+                self._teardown_deployment(dep)
+            self._routing_version += 1
+        return True
+
+    def shutdown_serve(self):
+        with self._lock:
+            for name in list(self._apps):
+                app = self._apps.pop(name)
+                for dep in app["deployments"].values():
+                    self._teardown_deployment(dep)
+            self._shutdown = True
+            self._routing_version += 1
+        return True
+
+    def _teardown_deployment(self, dep: _DeploymentState):
+        for r in dep.replicas:
+            self._stop_replica(dep, r, graceful=True)
+        dep.replicas = []
+
+    # ================================================= router-facing API
+
+    def routing_version(self) -> int:
+        return self._routing_version
+
+    def get_routing_snapshot(self, app_name: str, deployment: str):
+        """(version, [(replica_id, handle)], max_concurrent_queries)."""
+        with self._lock:
+            app = self._apps.get(app_name)
+            if app is None:
+                return self._routing_version, [], 1
+            dep = app["deployments"].get(deployment)
+            if dep is None:
+                return self._routing_version, [], 1
+            return (self._routing_version,
+                    [(r.replica_id, r.handle) for r in dep.running()],
+                    dep.config.max_concurrent_queries)
+
+    def get_routes(self) -> Dict[str, str]:
+        """route_prefix -> app name (for the HTTP proxy)."""
+        with self._lock:
+            return {app["route_prefix"]: name
+                    for name, app in self._apps.items()
+                    if app["route_prefix"]}
+
+    def get_ingress(self, app_name: str) -> Optional[str]:
+        with self._lock:
+            app = self._apps.get(app_name)
+            return app["ingress"] if app else None
+
+    def status(self) -> dict:
+        with self._lock:
+            out = {}
+            for name, app in self._apps.items():
+                deps = {}
+                statuses = []
+                for dn, dep in app["deployments"].items():
+                    counts: Dict[str, int] = {}
+                    for r in dep.replicas:
+                        counts[r.state] = counts.get(r.state, 0) + 1
+                    deps[dn] = {"status": dep.status,
+                                "message": dep.message,
+                                "replica_states": counts,
+                                "target_replicas": dep.target_replicas(),
+                                "version": dep.version}
+                    statuses.append(dep.status)
+                if any(s == DEPLOY_UNHEALTHY for s in statuses):
+                    app_status = "UNHEALTHY"
+                elif all(s == DEPLOY_HEALTHY for s in statuses) and statuses:
+                    app_status = "RUNNING"
+                else:
+                    app_status = "DEPLOYING"
+                out[name] = {"status": app_status,
+                             "route_prefix": app["route_prefix"],
+                             "deployments": deps}
+            return out
+
+    # ================================================= reconcile loop
+
+    def _control_loop(self):
+        while not self._shutdown:
+            try:
+                with self._lock:
+                    deps = [dep for app in self._apps.values()
+                            for dep in app["deployments"].values()]
+                for dep in deps:
+                    self._reconcile_deployment(dep)
+            except Exception:
+                traceback.print_exc()
+            time.sleep(_TICK_S)
+
+    def _reconcile_deployment(self, dep: _DeploymentState):
+        with self._lock:
+            self._check_starting(dep)
+            self._check_health_and_autoscale(dep)
+            self._scale(dep)
+            self._update_status(dep)
+
+    # ----- phase 1: STARTING -> RUNNING on successful ping
+
+    def _check_starting(self, dep: _DeploymentState):
+        for r in list(dep.replicas):
+            if r.state != STARTING:
+                continue
+            if r.ping_ref is None:
+                r.ping_ref = r.handle.ping.remote()
+            done, _ = ray_tpu.wait([r.ping_ref], num_returns=1, timeout=0,
+                                   fetch_local=False)
+            if not done:
+                if time.monotonic() - r.started_at > \
+                        dep.config.health_check_timeout_s:
+                    self._replica_failed(
+                        dep, r, "replica start timed out")
+                continue
+            try:
+                ray_tpu.get(r.ping_ref, timeout=1)
+            except Exception as e:  # noqa: BLE001 — ctor/ping failure
+                self._replica_failed(dep, r, repr(e))
+                continue
+            r.ping_ref = None
+            r.state = RUNNING
+            dep.start_failures = 0
+            self._routing_version += 1
+
+    def _replica_failed(self, dep: _DeploymentState, r: _Replica, msg: str):
+        dep.replicas.remove(r)
+        try:
+            ray_tpu.kill(r.handle)
+        except Exception:
+            pass
+        dep.start_failures += 1
+        dep.message = msg
+        if dep.start_failures >= _MAX_CONSECUTIVE_START_FAILURES:
+            dep.status = DEPLOY_UNHEALTHY
+
+    # ----- phase 2: health checks + autoscaling metrics on RUNNING
+
+    def _check_health_and_autoscale(self, dep: _DeploymentState):
+        now = time.monotonic()
+        total_ongoing = 0
+        n_reporting = 0
+        for r in list(dep.replicas):
+            if r.state != RUNNING:
+                continue
+            if r.metrics_ref is None:
+                if now - r.last_seen >= dep.config.health_check_period_s:
+                    r.metrics_ref = r.handle.metrics.remote()
+            else:
+                done, _ = ray_tpu.wait([r.metrics_ref], num_returns=1,
+                                       timeout=0, fetch_local=False)
+                if done:
+                    try:
+                        m = ray_tpu.get(r.metrics_ref, timeout=1)
+                        r.ongoing = m.num_ongoing_requests
+                        r.last_seen = now
+                    except Exception as e:  # noqa: BLE001 — replica died
+                        dep.replicas.remove(r)
+                        dep.message = f"replica died: {e!r}"
+                        self._routing_version += 1
+                        try:
+                            ray_tpu.kill(r.handle)
+                        except Exception:
+                            pass
+                        continue
+                    r.metrics_ref = None
+                elif now - r.last_seen > dep.config.health_check_timeout_s:
+                    dep.replicas.remove(r)
+                    dep.message = "replica health check timed out"
+                    self._routing_version += 1
+                    try:
+                        ray_tpu.kill(r.handle)
+                    except Exception:
+                        pass
+                    continue
+            total_ongoing += r.ongoing
+            n_reporting += 1
+        cfg = dep.config.autoscaling_config
+        if cfg is not None and n_reporting:
+            self._autoscale(dep, cfg, total_ongoing, now)
+
+    def _autoscale(self, dep: _DeploymentState, cfg: AutoscalingConfig,
+                   total_ongoing: int, now: float):
+        import math
+
+        raw = math.ceil(
+            cfg.smoothing_factor * total_ongoing /
+            cfg.target_num_ongoing_requests_per_replica)
+        desired = min(max(raw, cfg.min_replicas), cfg.max_replicas)
+        cur = dep.autoscale_desired
+        if desired > cur:
+            self._below_since = None
+            if dep._above_since is None:
+                dep._above_since = now
+            if now - dep._above_since >= cfg.upscale_delay_s:
+                dep.autoscale_desired = desired
+                dep._above_since = None
+        elif desired < cur:
+            dep._above_since = None
+            if dep._below_since is None:
+                dep._below_since = now
+            if now - dep._below_since >= cfg.downscale_delay_s:
+                dep.autoscale_desired = desired
+                dep._below_since = None
+        else:
+            dep._above_since = None
+            dep._below_since = None
+
+    # ----- phase 3: converge replica set to target count + version
+
+    def _scale(self, dep: _DeploymentState):
+        if dep.status == DEPLOY_UNHEALTHY:
+            return
+        target = dep.target_replicas()
+        current = [r for r in dep.replicas if r.state in (STARTING, RUNNING)]
+        new_version = [r for r in current if r.version == dep.version]
+        old_version = [r for r in current if r.version != dep.version]
+
+        # rolling update: bring up the new version to target, then retire old
+        if len(new_version) < target:
+            for _ in range(target - len(new_version)):
+                self._start_replica(dep)
+        elif old_version and len(dep.running(dep.version)) >= target:
+            for r in old_version:
+                dep.replicas.remove(r)
+                self._stop_replica(dep, r, graceful=True)
+            self._routing_version += 1
+        elif not old_version and len(new_version) > target:
+            # scale down newest-first among non-running, else last started
+            doomed = sorted(new_version,
+                            key=lambda r: (r.state == RUNNING, r.started_at)
+                            )[target - len(new_version):]
+            running_removed = False
+            for r in doomed:
+                running_removed |= r.state == RUNNING
+                dep.replicas.remove(r)
+                self._stop_replica(dep, r, graceful=True)
+            if running_removed:
+                self._routing_version += 1
+
+    def _start_replica(self, dep: _DeploymentState):
+        from .replica import ServeReplica
+
+        opts = dict(dep.config.ray_actor_options)
+        replica_id = f"{dep.app}#{dep.name}#{dep.next_replica_idx}"
+        dep.next_replica_idx += 1
+        actor_cls = ray_tpu.remote(ServeReplica).options(
+            num_cpus=opts.get("num_cpus", 0),
+            num_tpus=opts.get("num_tpus"),
+            resources=opts.get("resources"),
+            # queries + ping/metrics/drain must run concurrently
+            max_concurrency=dep.config.max_concurrent_queries + 3,
+        )
+        handle = actor_cls.remote(dep.payload, replica_id)
+        dep.replicas.append(_Replica(replica_id, handle, dep.version))
+
+    def _stop_replica(self, dep: _DeploymentState, r: _Replica,
+                      graceful: bool):
+        r.state = STOPPING
+
+        def _drain(handle=r.handle,
+                   timeout=dep.config.graceful_shutdown_timeout_s):
+            try:
+                if graceful:
+                    ray_tpu.get(handle.prepare_shutdown.remote(timeout),
+                                timeout=timeout + 5)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(handle)
+            except Exception:
+                pass
+
+        threading.Thread(target=_drain, daemon=True).start()
+
+    # ----- phase 4: status rollup
+
+    def _update_status(self, dep: _DeploymentState):
+        if dep.status == DEPLOY_UNHEALTHY:
+            return
+        target = dep.target_replicas()
+        if len(dep.running(dep.version)) == target and \
+                all(r.state == RUNNING for r in dep.replicas):
+            dep.status = DEPLOY_HEALTHY
+            dep.message = ""
+        else:
+            dep.status = DEPLOY_UPDATING
+
+
+def get_or_create_controller():
+    """Find the singleton controller, creating it on first use."""
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        pass
+    handle = ray_tpu.remote(ServeController).options(
+        name=CONTROLLER_NAME, num_cpus=0, max_concurrency=8).remote()
+    # wait until the name resolves and the actor answers
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get(handle.routing_version.remote(), timeout=5)
+            return handle
+        except Exception:
+            time.sleep(0.05)
+    raise RuntimeError("serve controller failed to start")

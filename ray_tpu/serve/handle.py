@@ -1,0 +1,71 @@
+"""DeploymentHandle — the Python-native way to call a deployment.
+
+Ref analog: python/ray/serve/handle.py:92 (RayServeHandle /
+DeploymentHandle). ``handle.remote(...)`` routes through the shared
+per-process Router and returns a DeploymentResponse future; responses can
+be passed straight into other handle calls (composition) — they convert to
+ObjectRefs so the downstream replica fetches the value without a hop
+through the caller.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import ray_tpu
+from ray_tpu.core.object_ref import ObjectRef
+
+
+class DeploymentResponse:
+    """Future for one deployment request."""
+
+    def __init__(self, ref: ObjectRef):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    def _to_object_ref(self) -> ObjectRef:
+        return self._ref
+
+    def __await__(self):
+        return self._ref.__await__()
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self.method_name = method_name
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self.deployment_name, self.app_name, name)
+
+    def options(self, *, method_name: Optional[str] = None
+                ) -> "DeploymentHandle":
+        return DeploymentHandle(self.deployment_name, self.app_name,
+                                method_name or self.method_name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        from .router import get_router
+
+        args = tuple(_to_ref(a) for a in args)
+        kwargs = {k: _to_ref(v) for k, v in kwargs.items()}
+        router = get_router(self.app_name, self.deployment_name)
+        ref = router.assign(self.method_name, args, kwargs)
+        return DeploymentResponse(ref)
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self.method_name))
+
+    def __repr__(self):
+        return (f"DeploymentHandle({self.app_name}/{self.deployment_name}"
+                f".{self.method_name})")
+
+
+def _to_ref(x):
+    return x._to_object_ref() if isinstance(x, DeploymentResponse) else x

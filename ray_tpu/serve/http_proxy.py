@@ -1,0 +1,139 @@
+"""HTTP ingress proxy: routes HTTP requests to application ingress handles.
+
+Ref analog: python/ray/serve/_private/http_proxy.py:661 (HTTPProxyActor,
+uvicorn/ASGI). Re-design: a threaded stdlib HTTP server inside a plain
+actor — no ASGI layer; JSON bodies map to handle args, results map back to
+JSON. Routes come from the controller's route table (route_prefix -> app),
+longest prefix wins, refreshed with a small TTL.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import ray_tpu
+
+PROXY_NAME = "SERVE_HTTP_PROXY"
+_ROUTES_TTL_S = 1.0
+
+
+class HTTPProxy:
+    """Actor hosting the HTTP server (create with max_concurrency > 1)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._routes = {}
+        self._routes_at = 0.0
+        self._controller = None
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # silence per-request stderr spam
+                pass
+
+            def _reply(self, code: int, payload: bytes,
+                       ctype: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _dispatch(self, body: Optional[bytes]):
+                path = self.path.split("?", 1)[0]
+                if path == "/-/healthz":
+                    self._reply(200, b'"ok"')
+                    return
+                if path == "/-/routes":
+                    self._reply(200, json.dumps(
+                        proxy._route_table()).encode())
+                    return
+                app = proxy._match(path)
+                if app is None:
+                    self._reply(404, json.dumps(
+                        {"error": f"no app mounted at {path}"}).encode())
+                    return
+                try:
+                    arg = None
+                    if body:
+                        try:
+                            arg = json.loads(body)
+                        except json.JSONDecodeError:
+                            arg = body.decode("utf-8", "replace")
+                    handle = proxy._app_handle(app)
+                    result = handle.remote(arg).result(timeout_s=60)
+                    if isinstance(result, bytes):
+                        self._reply(200, result,
+                                    "application/octet-stream")
+                    else:
+                        self._reply(200, json.dumps(result).encode())
+                except Exception as e:  # noqa: BLE001 — surface to client
+                    self._reply(500, json.dumps(
+                        {"error": repr(e)}).encode())
+
+            def do_GET(self):
+                self._dispatch(None)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self._dispatch(self.rfile.read(n) if n else None)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="serve-http")
+        self._thread.start()
+
+    # ------------------------------------------------------------- helpers
+
+    def _controller_handle(self):
+        if self._controller is None:
+            from .controller import CONTROLLER_NAME
+
+            self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        return self._controller
+
+    def _route_table(self) -> dict:
+        now = time.monotonic()
+        if now - self._routes_at > _ROUTES_TTL_S:
+            try:
+                self._routes = ray_tpu.get(
+                    self._controller_handle().get_routes.remote(), timeout=10)
+                self._routes_at = now
+            except Exception:
+                pass
+        return self._routes
+
+    def _match(self, path: str) -> Optional[str]:
+        best, best_len = None, -1
+        for prefix, app in self._route_table().items():
+            norm = prefix.rstrip("/") or "/"
+            if (path == norm or path.startswith(norm.rstrip("/") + "/")
+                    or norm == "/") and len(norm) > best_len:
+                best, best_len = app, len(norm)
+        return best
+
+    def _app_handle(self, app: str):
+        from .handle import DeploymentHandle
+
+        ingress = ray_tpu.get(
+            self._controller_handle().get_ingress.remote(app), timeout=10)
+        return DeploymentHandle(ingress, app)
+
+    # -------------------------------------------------------------- public
+
+    def port(self) -> int:
+        return self._port
+
+    def ready(self) -> bool:
+        return True
+
+    def stop(self):
+        self._server.shutdown()
+        return True

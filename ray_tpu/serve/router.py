@@ -1,0 +1,162 @@
+"""Client-side request router: power-of-two-choices replica selection.
+
+Ref analog: python/ray/serve/_private/router.py:281
+(PowerOfTwoChoicesReplicaScheduler) + :985 (Router). Re-design: no asyncio —
+a per-process router per deployment tracks its own in-flight count per
+replica, picks the less-loaded of two random replicas, and blocks (with
+backpressure) when every replica is at ``max_concurrent_queries``. Replica
+membership is refreshed from the controller when its ``routing_version``
+moves (polled with a small TTL; the reference uses a long-poll broker).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import ray_tpu
+
+_REFRESH_TTL_S = 0.25
+
+
+class Router:
+    def __init__(self, app_name: str, deployment: str):
+        self.app = app_name
+        self.deployment = deployment
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._replicas: List[Tuple[str, object]] = []  # (replica_id, handle)
+        self._inflight: Dict[str, int] = {}
+        self._max_q = 1
+        self._version = -1
+        self._last_refresh = 0.0
+        self._outstanding: Dict[object, str] = {}  # ObjectRef -> replica_id
+        self._drainer: Optional[threading.Thread] = None
+        self._controller = None
+
+    # ------------------------------------------------------------ membership
+
+    def _controller_handle(self):
+        if self._controller is None:
+            from .controller import CONTROLLER_NAME
+
+            self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        return self._controller
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_refresh < _REFRESH_TTL_S:
+            return
+        self._last_refresh = now
+        ctrl = self._controller_handle()
+        version, replicas, max_q = ray_tpu.get(
+            ctrl.get_routing_snapshot.remote(self.app, self.deployment),
+            timeout=30)
+        with self._lock:
+            if version != self._version:
+                self._version = version
+                self._replicas = replicas
+                self._max_q = max(1, max_q)
+                known = {rid for rid, _ in replicas}
+                self._inflight = {rid: self._inflight.get(rid, 0)
+                                  for rid in known}
+                self._cond.notify_all()
+
+    # ------------------------------------------------------------- dispatch
+
+    def assign(self, method_name: str, args: tuple, kwargs: dict,
+               timeout_s: float = 60.0):
+        """Pick a replica (power of two choices) and push the request.
+
+        Returns the resulting ObjectRef. Blocks while all replicas are at
+        max_concurrent_queries (client-side backpressure).
+        """
+        self._refresh()
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                choice = self._choose_locked()
+                if choice is not None:
+                    rid, handle = choice
+                    self._inflight[rid] = self._inflight.get(rid, 0) + 1
+                    ref = None
+                    try:
+                        ref = handle.handle_request.remote(
+                            method_name, args, kwargs)
+                        self._outstanding[ref] = rid
+                        self._ensure_drainer_locked()
+                        return ref
+                    finally:
+                        if ref is None:  # submission itself failed
+                            self._inflight[rid] -= 1
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"no replica of {self.app}/{self.deployment} "
+                        f"available within {timeout_s}s")
+                self._cond.wait(min(remaining, _REFRESH_TTL_S))
+            self._refresh(force=not self._replicas)
+
+    def _choose_locked(self) -> Optional[Tuple[str, object]]:
+        avail = [(rid, h) for rid, h in self._replicas
+                 if self._inflight.get(rid, 0) < self._max_q]
+        if not avail:
+            return None
+        if len(avail) == 1:
+            return avail[0]
+        a, b = random.sample(avail, 2)
+        return a if self._inflight.get(a[0], 0) <= \
+            self._inflight.get(b[0], 0) else b
+
+    # ------------------------------------------------------------ drain loop
+
+    def _ensure_drainer_locked(self):
+        if self._drainer is None or not self._drainer.is_alive():
+            self._drainer = threading.Thread(
+                target=self._drain_loop, daemon=True,
+                name=f"serve-router-{self.deployment}")
+            self._drainer.start()
+
+    def _drain_loop(self):
+        """Release in-flight slots as replica replies land."""
+        while True:
+            with self._lock:
+                refs = list(self._outstanding)
+            if not refs:
+                with self._lock:
+                    if not self._outstanding:
+                        self._drainer = None
+                        return
+                continue
+            done, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.2,
+                                   fetch_local=False)
+            if not done:
+                continue
+            with self._lock:
+                for ref in done:
+                    rid = self._outstanding.pop(ref, None)
+                    if rid is not None and rid in self._inflight:
+                        self._inflight[rid] = max(
+                            0, self._inflight[rid] - 1)
+                self._cond.notify_all()
+
+
+_routers: Dict[Tuple[str, str], Router] = {}
+_routers_lock = threading.Lock()
+
+
+def get_router(app_name: str, deployment: str) -> Router:
+    key = (app_name, deployment)
+    with _routers_lock:
+        r = _routers.get(key)
+        if r is None:
+            r = _routers[key] = Router(app_name, deployment)
+        return r
+
+
+def reset_routers():
+    """Drop cached routers (test isolation across serve sessions)."""
+    with _routers_lock:
+        _routers.clear()

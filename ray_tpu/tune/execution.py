@@ -129,14 +129,18 @@ class TuneController:
     # ------------------------------------------------------------- main loop
 
     def _fill_trials(self):
-        while not self._exhausted and \
-                len(self._runners) < self._max_concurrent:
-            # resume paused before asking the searcher for new configs
+        while len(self._runners) < self._max_concurrent:
+            # Resume paused trials whenever a slot frees, regardless of
+            # searcher exhaustion — gating this on `not _exhausted` livelocks
+            # custom PAUSE-ing schedulers once the searcher runs dry
+            # (round-1 ADVICE, medium).
             paused = [t for t in self.trials if t.status == PAUSED]
             if paused:
                 trial = paused[0]
                 self._start_trial(trial)
                 continue
+            if self._exhausted:
+                break
             tid = f"t{len(self.trials):05d}"
             cfg = self._searcher.suggest(tid)
             if cfg is None:
