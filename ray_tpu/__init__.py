@@ -26,6 +26,7 @@ from ray_tpu.core.api import (
     cluster_resources,
     get,
     get_actor,
+    get_tpu_ids,
     init,
     is_initialized,
     kill,
@@ -51,7 +52,8 @@ from ray_tpu.core.object_ref import ObjectRef
 
 __all__ = [
     "__version__", "init", "shutdown", "remote", "get", "put", "wait",
-    "kill", "cancel", "get_actor", "is_initialized", "ObjectRef",
+    "kill", "cancel", "get_actor", "get_tpu_ids", "is_initialized",
+    "ObjectRef",
     "ActorClass", "ActorHandle", "PlacementGroup", "placement_group",
     "remove_placement_group", "placement_group_table",
     "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",

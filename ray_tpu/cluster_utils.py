@@ -45,5 +45,69 @@ class Cluster:
         """Kill a logical node (workers die, objects on it are lost)."""
         self.head.remove_node(node_idx)
 
+    # ------------------------------------------------ real remote processes
+
+    def enable_tcp(self, host: str = "127.0.0.1") -> str:
+        """Open the head's TCP port; returns the tcp: address to join."""
+        return self.head.enable_tcp(host=host, advertise_ip=host)
+
+    def add_remote_node(self, *, num_cpus: int = 1, num_tpus: int = 0,
+                        object_store_memory: Optional[int] = None,
+                        timeout: float = 60.0):
+        """Start a real node-agent PROCESS that joins over TCP — exercises
+        the full multi-host path (TCP registration, delegated worker fork,
+        cross-host object transfer) on one machine. Returns a
+        RemoteNodeHandle with .node_idx / .terminate().
+        """
+        import os
+        import subprocess
+        import sys
+        import time
+
+        addr = self.enable_tcp()
+        known = set(self.head.nodes)
+        import ray_tpu as _pkg
+
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.abspath(_pkg.__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_parent + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        cmd = [sys.executable, "-m", "ray_tpu.core.node_agent",
+               "--address", addr, "--num-cpus", str(num_cpus),
+               "--num-tpus", str(num_tpus)]
+        if object_store_memory:
+            cmd += ["--object-store-memory", str(object_store_memory)]
+        proc = subprocess.Popen(cmd, env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            new = set(self.head.nodes) - known
+            if new:
+                return RemoteNodeHandle(proc, new.pop())
+            if proc.poll() is not None:
+                out = proc.stdout.read().decode(errors="replace")
+                raise RuntimeError(f"node agent died: {out[-2000:]}")
+            time.sleep(0.05)
+        proc.kill()
+        raise TimeoutError("node agent did not register in time")
+
     def shutdown(self):
         api.shutdown()
+
+
+class RemoteNodeHandle:
+    def __init__(self, proc, node_idx: int):
+        self.proc = proc
+        self.node_idx = node_idx
+
+    def terminate(self):
+        """Kill the agent process (simulates host loss)."""
+        if self.proc.poll() is None:
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+        self.proc.wait(timeout=10)

@@ -1,0 +1,30 @@
+"""ray_tpu.collective — host-plane collective communication.
+
+TPU-first split of the reference's ray.util.collective (SURVEY.md §2.3):
+tensor-plane collectives are XLA programs (jax.lax.psum et al. over ICI —
+see ray_tpu.parallel); this module covers the host plane the reference
+used NCCL/Gloo groups for: gang barriers, broadcasts, small-array
+allreduce/allgather between actors, via a per-group rendezvous actor.
+"""
+
+from .collective import (
+    Rendezvous,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    reduce,
+)
+
+__all__ = [
+    "init_collective_group", "destroy_collective_group", "allreduce",
+    "allgather", "broadcast", "barrier", "reduce", "get_rank",
+    "get_collective_group_size", "is_group_initialized",
+    "create_collective_group", "Rendezvous",
+]

@@ -1,0 +1,257 @@
+"""Host-level collective groups over the runtime control plane.
+
+Ref analog: python/ray/util/collective/collective.py (GroupManager :40,
+init_collective_group :120, allreduce :258) — with the TPU-first split
+(SURVEY.md §2.3): *tensor* collectives live inside compiled XLA programs
+(psum/all_gather over ICI; see ray_tpu.parallel), so this module only
+provides the *host-plane* collectives the reference used NCCL/Gloo for —
+gang barriers, config broadcast, small-array allreduce/allgather between
+actors — implemented with a rendezvous coordinator actor per group.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_REDUCE_OPS = {
+    "sum": lambda xs: _tree_reduce(xs, np.add),
+    "prod": lambda xs: _tree_reduce(xs, np.multiply),
+    "max": lambda xs: _tree_reduce(xs, np.maximum),
+    "min": lambda xs: _tree_reduce(xs, np.minimum),
+}
+
+
+def _tree_reduce(xs: List[Any], op):
+    out = xs[0]
+    for x in xs[1:]:
+        out = op(out, x)
+    return out
+
+
+class Rendezvous:
+    """Coordinator actor: one per group; collects one contribution per rank
+    per round, computes the result, hands it back to every caller.
+
+    Create with max_concurrency >= world_size + 1 so all ranks can block
+    inside ``contribute`` concurrently.
+    """
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._rounds: Dict[tuple, dict] = {}
+
+    def contribute(self, kind: str, seq: int, rank: int, payload,
+                   op: str = "sum", src_rank: int = 0,
+                   timeout: float = 300.0):
+        key = (kind, seq)
+        with self._cond:
+            state = self._rounds.setdefault(
+                key, {"parts": {}, "result": None, "done": False,
+                      "claimed": 0})
+            state["parts"][rank] = payload
+            if len(state["parts"]) == self.world_size:
+                state["result"] = self._finish(kind, state["parts"], op,
+                                               src_rank)
+                state["done"] = True
+                self._cond.notify_all()
+            else:
+                ok = self._cond.wait_for(lambda: state["done"],
+                                         timeout=timeout)
+                if not ok:
+                    raise TimeoutError(
+                        f"collective {kind}#{seq}: only "
+                        f"{len(state['parts'])}/{self.world_size} ranks "
+                        f"arrived within {timeout}s")
+            result = state["result"]
+            state["claimed"] += 1
+            if state["claimed"] == self.world_size:
+                del self._rounds[key]
+        if kind == "allgather":
+            return result
+        if kind == "barrier":
+            return True
+        if kind == "broadcast":
+            return result
+        return result
+
+    def _finish(self, kind: str, parts: Dict[int, Any], op: str,
+                src_rank: int):
+        if kind == "barrier":
+            return True
+        if kind == "broadcast":
+            return parts[src_rank]
+        ordered = [parts[r] for r in sorted(parts)]
+        if kind == "allgather":
+            return ordered
+        if kind == "allreduce" or kind == "reduce":
+            return _REDUCE_OPS[op](ordered)
+        raise ValueError(f"unknown collective kind {kind}")
+
+    def ping(self) -> bool:
+        return True
+
+
+class _GroupState:
+    def __init__(self, name: str, world_size: int, rank: int, handle):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.handle = handle
+        self.seq = 0
+        self.lock = threading.Lock()
+
+    def next_seq(self) -> int:
+        with self.lock:
+            self.seq += 1
+            return self.seq
+
+
+_groups: Dict[str, _GroupState] = {}
+_groups_lock = threading.Lock()
+
+
+def _coordinator_name(group_name: str) -> str:
+    return f"__collective_{group_name}"
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "host",
+                          group_name: str = "default"):
+    """Join this process to a named group (call once per member).
+
+    Rank 0 creates the rendezvous coordinator actor; other ranks look it
+    up by name (ref: collective.py:120 + the named-store rendezvous
+    :40-118).
+    """
+    import ray_tpu
+
+    if backend not in ("host", "jax"):
+        raise ValueError(f"unsupported backend {backend!r}")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for {world_size}")
+    name = _coordinator_name(group_name)
+    handle = None
+    if rank == 0:
+        try:
+            handle = ray_tpu.remote(Rendezvous).options(
+                name=name, num_cpus=0,
+                max_concurrency=world_size + 2).remote(world_size)
+        except Exception:
+            handle = None
+    if handle is None:
+        import time
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                handle = ray_tpu.get_actor(name)
+                break
+            except ValueError:
+                time.sleep(0.05)
+        else:
+            raise TimeoutError(f"collective group {group_name} never "
+                               "materialized")
+    with _groups_lock:
+        _groups[group_name] = _GroupState(group_name, world_size, rank,
+                                          handle)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    with _groups_lock:
+        return group_name in _groups
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _get(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _get(group_name).world_size
+
+
+def destroy_collective_group(group_name: str = "default"):
+    import ray_tpu
+
+    with _groups_lock:
+        st = _groups.pop(group_name, None)
+    if st is not None and st.rank == 0:
+        try:
+            ray_tpu.kill(ray_tpu.get_actor(_coordinator_name(group_name)))
+        except Exception:
+            pass
+
+
+def _get(group_name: str) -> _GroupState:
+    with _groups_lock:
+        st = _groups.get(group_name)
+    if st is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized; call "
+            "init_collective_group first")
+    return st
+
+
+def _run(kind: str, group_name: str, payload, **kw):
+    import ray_tpu
+
+    st = _get(group_name)
+    seq = st.next_seq()
+    return ray_tpu.get(
+        st.handle.contribute.remote(kind, seq, st.rank, payload, **kw),
+        timeout=kw.get("timeout", 300.0) + 30)
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    """Reduce across the group; returns the reduced array (and copies it
+    into ``tensor`` in place when it's a writable ndarray, matching the
+    reference's in-place contract, collective.py:258)."""
+    result = _run("allreduce", group_name, np.asarray(tensor), op=op)
+    if isinstance(tensor, np.ndarray) and tensor.flags.writeable:
+        np.copyto(tensor, result)
+    return result
+
+
+def allgather(tensor, group_name: str = "default") -> List[Any]:
+    return _run("allgather", group_name, np.asarray(tensor))
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    result = _run("broadcast", group_name, np.asarray(tensor),
+                  src_rank=src_rank)
+    if isinstance(tensor, np.ndarray) and tensor.flags.writeable:
+        np.copyto(tensor, result)
+    return result
+
+
+def barrier(group_name: str = "default"):
+    _run("barrier", group_name, None)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: str = "sum"):
+    """All ranks contribute; only dst_rank gets the result (others get
+    their input back, matching the reference's semantics loosely)."""
+    st = _get(group_name)
+    result = _run("reduce", group_name, np.asarray(tensor), op=op)
+    return result if st.rank == dst_rank else tensor
+
+
+def create_collective_group(actors: list, world_size: int,
+                            ranks: List[int],
+                            backend: str = "host",
+                            group_name: str = "default"):
+    """Declarative form: initialize the group on a list of actor handles
+    (each must expose ``init_collective(world_size, rank, group_name)``;
+    ref: collective.py:151)."""
+    import ray_tpu
+
+    if len(actors) != len(ranks):
+        raise ValueError("actors and ranks must align")
+    refs = [a.init_collective.remote(world_size, r, group_name)
+            for a, r in zip(actors, ranks)]
+    ray_tpu.get(refs, timeout=120)

@@ -445,6 +445,13 @@ class NodeAffinitySchedulingStrategy(SchedulingStrategy):
                          soft=soft)
 
 
+def get_tpu_ids() -> List[int]:
+    """Chip indices assigned to the current task/actor's lease (the
+    reference's ``ray.get_gpu_ids()``, worker.py:888). Empty outside a
+    TPU-resourced task."""
+    return list(get_context().assigned_tpu_ids)
+
+
 def nodes() -> list:
     return get_context().node_info()
 

@@ -59,15 +59,16 @@ def set_context(ctx: Optional["CoreContext"]):
 
 class _LeasedWorker:
     __slots__ = ("worker_id", "addr", "lease_id", "conn", "inflight",
-                 "idle_since")
+                 "idle_since", "tpu_ids")
 
-    def __init__(self, worker_id, addr, lease_id, conn):
+    def __init__(self, worker_id, addr, lease_id, conn, tpu_ids=None):
         self.worker_id = worker_id
         self.addr = addr
         self.lease_id = lease_id
         self.conn = conn
         self.inflight: Dict[TaskID, TaskSpec] = {}
         self.idle_since = time.monotonic()
+        self.tpu_ids = tpu_ids
 
 
 class _ClassState:
@@ -128,6 +129,7 @@ class CoreContext:
             self.worker_id, self._free_owned_object, self._release_borrow)
 
         # executor / misc state (must exist before any thread starts)
+        self.assigned_tpu_ids: List[int] = []
         self._exec_queue: "queue_mod.Queue" = queue_mod.Queue()
         self._actor_instance = None
         self._actor_spec: Optional[TaskSpec] = None
@@ -141,11 +143,21 @@ class CoreContext:
         self._pub_lock = threading.Lock()
 
         self.io = P.IOLoop(f"io-{self.worker_id[:6]}")
-        # Own listener for direct pushes from peers.
-        self.listen_path = os.path.join(session_dir,
-                                        f"w_{self.worker_id[:12]}.sock")
-        self.listen_addr = f"unix:{self.listen_path}"
-        self._listener = P.listen_unix(self.listen_path)
+        # Own listener for direct pushes from peers. On a remote node
+        # (RAY_TPU_NODE_IP set by its agent) listen on TCP so workers on
+        # other hosts can push tasks directly (the reference's
+        # CoreWorkerService over gRPC); same-host clusters use unix sockets.
+        node_ip = os.environ.get("RAY_TPU_NODE_IP", "")
+        if node_ip:
+            self._listener = P.listen_tcp("0.0.0.0", 0)
+            port = self._listener.getsockname()[1]
+            self.listen_path = ""
+            self.listen_addr = f"tcp:{node_ip}:{port}"
+        else:
+            self.listen_path = os.path.join(
+                session_dir, f"w_{self.worker_id[:12]}.sock")
+            self.listen_addr = f"unix:{self.listen_path}"
+            self._listener = P.listen_unix(self.listen_path)
         self.io.add_listener(self._listener, self._on_accept)
 
         # Head connection (GCS + raylet client).
@@ -582,6 +594,7 @@ class CoreContext:
                     continue
                 worker.inflight[spec.task_id] = spec
                 worker.idle_since = time.monotonic()
+            spec.tpu_ids = worker.tpu_ids
             try:
                 worker.conn.send(P.PUSH_TASK, spec, 0)
             except P.ConnectionLost:
@@ -599,9 +612,11 @@ class CoreContext:
                 st.pending_leases -= 1
             return
         try:
-            ok, worker_id, addr, lease_id, err = self.head.call(
+            reply = self.head.call(
                 P.LEASE_REQUEST, cls, sample.resources, self.job_id.hex(),
                 dumps(sample.strategy), timeout=None)
+            ok, worker_id, addr, lease_id, err = reply[:5]
+            tpu_ids = reply[5] if len(reply) > 5 else None
         except Exception as e:  # noqa: BLE001
             with self._sub_lock:
                 st.pending_leases -= 1
@@ -616,7 +631,7 @@ class CoreContext:
             self._submit_event.set()
             return
         conn = P.Connection(sock, peer=f"lease:{worker_id[:8]}")
-        lw = _LeasedWorker(worker_id, addr, lease_id, conn)
+        lw = _LeasedWorker(worker_id, addr, lease_id, conn, tpu_ids)
         conn.on_close = lambda c, cls=cls, st=st, lw=lw: \
             self._on_lease_worker_lost(cls, st, lw)
         self.io.add_connection(conn, self._on_peer_message)
@@ -1073,6 +1088,13 @@ class CoreContext:
                       None)
             return
         self.current_task_id = spec.task_id
+        if spec.tpu_ids is not None:
+            # Export the head-assigned chips before user code imports JAX
+            # (the reference sets CUDA_VISIBLE_DEVICES the same way,
+            # worker.py:888).
+            self.assigned_tpu_ids = list(spec.tpu_ids)
+            os.environ["TPU_VISIBLE_CHIPS"] = ",".join(
+                str(i) for i in spec.tpu_ids)
         try:
             if spec.task_type == TaskType.ACTOR_CREATION:
                 cls = self.fn_manager.fetch(spec.function_id)
@@ -1199,7 +1221,8 @@ class CoreContext:
         self.io.stop()
         try:
             self._listener.close()
-            os.unlink(self.listen_path)
+            if self.listen_path:
+                os.unlink(self.listen_path)
         except OSError:
             pass
         try:

@@ -28,6 +28,20 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from . import protocol as P
+
+
+def _local_ip() -> str:
+    """Best-effort primary IP (falls back to loopback in sandboxes)."""
+    import socket as _socket
+
+    try:
+        s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
 from .config import get_config
 from .ids import ActorID, ObjectID, PlacementGroupID
 from .object_store import ShmObjectStore
@@ -81,11 +95,21 @@ class PgInfo:
 class NodeState:
     idx: int
     resources: NodeResources
-    store: ShmObjectStore
+    store: Optional[ShmObjectStore]  # None for remote nodes (agent owns it)
     store_name: str
     workers: Dict[str, WorkerInfo] = field(default_factory=dict)
     idle_by_class: Dict[tuple, List[str]] = field(default_factory=dict)
     alive: bool = True
+    # per-chip assignment pool (lazily built from the TPU resource total)
+    tpu_free: Optional[List[int]] = None
+    # remote-node plumbing (multi-host over TCP; the reference's raylet)
+    agent_conn: Optional[P.Connection] = None
+    node_ip: str = ""
+    session_dir: str = ""
+
+    @property
+    def is_remote(self) -> bool:
+        return self.agent_conn is not None
 
 
 @dataclass
@@ -119,12 +143,28 @@ class Head:
         self.io = P.IOLoop("head-io")
         self._listener = P.listen_unix(f"{session_dir}/head.sock")
         self.io.add_listener(self._listener, self._on_accept)
+        self._tcp_listener = None
+        self.tcp_addr: str = ""
         self._next_node_idx = 0
         self._driver_conn: Optional[P.Connection] = None
         self._shutdown = False
 
     def start(self):
         self.io.start()
+
+    def enable_tcp(self, host: str = "0.0.0.0", port: int = 0,
+                   advertise_ip: str = "") -> str:
+        """Open the TCP control-plane listener so other hosts can join
+        (the reference's gRPC GcsServer port; SURVEY.md §5 DCN plane)."""
+        if self.tcp_addr:
+            return self.tcp_addr
+        self._tcp_listener = P.listen_tcp(host, port)
+        bound_port = self._tcp_listener.getsockname()[1]
+        ip = advertise_ip or (host if host not in ("0.0.0.0", "") else
+                              _local_ip())
+        self.tcp_addr = f"tcp:{ip}:{bound_port}"
+        self.io.add_listener(self._tcp_listener, self._on_accept)
+        return self.tcp_addr
 
     # ------------------------------------------------------------- nodes
 
@@ -151,8 +191,39 @@ class Head:
             self.scheduler.add_node(idx, nr)
         return idx
 
+    def register_remote_node(self, conn: P.Connection, resources,
+                             store_name: str, node_ip: str,
+                             session_dir: str) -> int:
+        """A node agent on another host joins over TCP (the reference's
+        raylet registration with the GCS, gcs_node_manager.cc)."""
+        with self._lock:
+            idx = self._next_node_idx
+            self._next_node_idx += 1
+            node = NodeState(idx=idx, resources=resources, store=None,
+                             store_name=store_name, agent_conn=conn,
+                             node_ip=node_ip, session_dir=session_dir)
+            self.nodes[idx] = node
+            self.scheduler.add_node(idx, resources)
+        conn.peer = f"agent:node{idx}"
+        conn.on_close = lambda c, i=idx: self._on_agent_close(i)
+        self._publish("node_added", dumps(idx))
+        return idx
+
+    def _on_agent_close(self, idx: int):
+        """Agent connection lost => the host is gone (failure detection)."""
+        if not self._shutdown:
+            self.remove_node(idx, kill_workers=True)
+
+    def _h_register_node(self, conn, rid, resources, store_name, node_ip,
+                         session_dir):
+        idx = self.register_remote_node(conn, resources, store_name,
+                                        node_ip, session_dir)
+        conn.reply(rid, idx, self.session_name,
+                   msg_type=P.REGISTER_NODE_REPLY)
+        self._try_fulfill_pending()
+
     def remove_node(self, idx: int, kill_workers: bool = True):
-        """Simulate node failure (chaos testing / scale-down)."""
+        """Node failure (chaos testing / scale-down / agent loss)."""
         with self._lock:
             node = self.nodes.pop(idx, None)
             self.scheduler.remove_node(idx)
@@ -168,7 +239,11 @@ class Head:
                     if loc.node_idx == idx and not loc.spilled_path]
             for oid in lost:
                 del self.objects[oid]
-        node.store.close()
+        if node.store is not None:
+            node.store.close()
+        if node.agent_conn is not None:
+            node.agent_conn.on_close = None
+            node.agent_conn.close()
         self._publish("node_removed", dumps(idx))
 
     def _kill_worker_process(self, w: WorkerInfo):
@@ -180,6 +255,14 @@ class Head:
                 w.proc.kill()
             except OSError:
                 pass
+        elif w.proc is None:
+            # remote worker: ask its node agent to kill the process
+            node = self.nodes.get(w.node_idx)
+            if node is not None and node.agent_conn is not None:
+                try:
+                    node.agent_conn.send(P.KILL_WORKER, w.worker_id)
+                except P.ConnectionLost:
+                    pass
 
     # --------------------------------------------------------- accept/IO
 
@@ -241,7 +324,8 @@ class Head:
                 if w.sched_class is not None:
                     node.idle_by_class.setdefault(w.sched_class, []).append(
                         worker_id)
-        conn.reply(rid, node.store_name, self.session_dir)
+        conn.reply(rid, node.store_name,
+                   node.session_dir or self.session_dir)
         self._try_fulfill_pending()
 
     def register_driver(self, conn: Optional[P.Connection] = None):
@@ -290,8 +374,10 @@ class Head:
                 worker, lease_id = grant
                 if worker == "spawning":
                     continue  # re-queued internally once worker registers
+                tpu_ids = self.leases[lease_id][4]
                 conn.reply(rid, True, worker.worker_id, worker.listen_addr,
-                           lease_id, None, msg_type=P.LEASE_REPLY)
+                           lease_id, None, tpu_ids,
+                           msg_type=P.LEASE_REPLY)
             if not granted:
                 return
 
@@ -327,8 +413,10 @@ class Head:
             else:
                 node.resources.allocate(request)
             lease_id = uuid.uuid4().hex
-            self.leases[lease_id] = (node_idx, request, "", pg_id and (
-                pg_id, strategy.bundle_index))
+            tpu_ids = self._allocate_tpu_chips(node, request)
+            pg_binding = pg_id and (pg_id, strategy.bundle_index)
+            self.leases[lease_id] = (node_idx, request, "", pg_binding,
+                                     tpu_ids)
             # find idle worker of this class
             idle = node.idle_by_class.get(sched_class)
             if idle:
@@ -337,7 +425,7 @@ class Head:
                 w.state = "leased"
                 w.lease_id = lease_id
                 self.leases[lease_id] = (node_idx, request, wid,
-                                         self.leases[lease_id][3])
+                                         pg_binding, tpu_ids)
                 return w, lease_id
             # reuse any idle worker (repurpose across scheduling classes)
             for cls, lst in node.idle_by_class.items():
@@ -348,7 +436,7 @@ class Head:
                     w.sched_class = sched_class
                     w.lease_id = lease_id
                     self.leases[lease_id] = (node_idx, request, wid,
-                                             self.leases[lease_id][3])
+                                             pg_binding, tpu_ids)
                     return w, lease_id
             # spawn a new worker (unless enough are already starting),
             # re-queue the lease until it registers
@@ -363,8 +451,31 @@ class Head:
                 self._pg_release(pg_id, strategy.bundle_index, request)
             else:
                 node.resources.release(request)
+            self._release_tpu_chips(node, tpu_ids)
             del self.leases[lease_id]
             return None
+
+    def _allocate_tpu_chips(self, node: NodeState, request: ResourceSet):
+        """Assign specific chip indices for a TPU lease — the reference's
+        CUDA_VISIBLE_DEVICES assignment (worker.py:888 get_gpu_ids,
+        resource-instance ids); workers export TPU_VISIBLE_CHIPS.
+
+        Caller holds the lock (called from _try_grant after allocation).
+        """
+        n = int(request.to_dict().get("TPU", 0))
+        if n <= 0:
+            return None
+        if node.tpu_free is None:
+            total = int(node.resources.total.to_dict().get("TPU", 0))
+            node.tpu_free = list(range(total))
+        chips = node.tpu_free[:n]
+        del node.tpu_free[:n]
+        return chips
+
+    def _release_tpu_chips(self, node: NodeState, tpu_ids):
+        if tpu_ids and node.tpu_free is not None:
+            node.tpu_free.extend(tpu_ids)
+            node.tpu_free.sort()
 
     def _spawn_worker(self, node: NodeState, sched_class) -> WorkerInfo:
         cfg = get_config()
@@ -376,6 +487,15 @@ class Head:
                        sched_class=sched_class,
                        spawned_at=time.monotonic())
         node.workers[worker_id] = w
+        if node.is_remote:
+            # delegated fork: the node agent on the remote host Popens the
+            # worker (the reference's raylet WorkerPool::StartWorkerProcess)
+            try:
+                node.agent_conn.send(P.SPAWN_WORKER, worker_id)
+            except P.ConnectionLost:
+                node.workers.pop(worker_id, None)
+                return None  # type: ignore[return-value]
+            return w
         env = dict(os.environ)
         # Ship the driver's full sys.path to workers (the reference does the
         # same via its runtime env / worker setup, worker.py): functions and
@@ -402,6 +522,12 @@ class Head:
             # unless a task explicitly requests TPU resources.
             "JAX_PLATFORMS": env_jax_platform(node),
         })
+        if env["JAX_PLATFORMS"] == "cpu":
+            # The host sitecustomize force-registers the axon (tunneled TPU)
+            # PJRT backend whenever this var is set, overriding JAX_PLATFORMS
+            # and clobbering jax.distributed state — CPU-only workers must
+            # not load it.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         out = open(os.path.join(log_dir, f"worker-{worker_id[:8]}.out"), "ab")
@@ -416,7 +542,7 @@ class Head:
             lease = self.leases.pop(lease_id, None)
             if lease is None:
                 return
-            node_idx, request, _, pg_binding = lease
+            node_idx, request, _, pg_binding, tpu_ids = lease
             node = self.nodes.get(node_idx)
             if node is None:
                 return
@@ -424,6 +550,7 @@ class Head:
                 self._pg_release(pg_binding[0], pg_binding[1], request)
             else:
                 node.resources.release(request)
+            self._release_tpu_chips(node, tpu_ids)
             w = node.workers.get(worker_id)
             if w is not None and w.state == "leased":
                 if dispose:
@@ -446,12 +573,13 @@ class Head:
                     if w.worker_id in lst:
                         lst.remove(w.worker_id)
                 if w.lease_id and w.lease_id in self.leases:
-                    node_idx, request, _, pg_binding = self.leases.pop(
-                        w.lease_id)
+                    node_idx, request, _, pg_binding, tpu_ids = \
+                        self.leases.pop(w.lease_id)
                     if pg_binding:
                         self._pg_release(pg_binding[0], pg_binding[1], request)
                     else:
                         node.resources.release(request)
+                    self._release_tpu_chips(node, tpu_ids)
             actor_id = w.actor_id
         if actor_id is not None:
             self._on_actor_worker_death(actor_id)
@@ -502,8 +630,11 @@ class Head:
                 w.actor_id = spec.actor_id
                 info.worker_id = w.worker_id
                 info.listen_addr = w.listen_addr
+                tpu_ids = self.leases[lease_id][4]
             try:
-                w.conn.send(P.PUSH_TASK, loads(dumps(spec)), 0)
+                push_spec = loads(dumps(spec))
+                push_spec.tpu_ids = tpu_ids
+                w.conn.send(P.PUSH_TASK, push_spec, 0)
             except P.ConnectionLost:
                 self._on_actor_worker_death(spec.actor_id)
                 return
@@ -865,54 +996,108 @@ class Head:
                     pass
             node = self.nodes.get(loc.node_idx)
             if node is not None and node.alive:
-                node.store.delete(oid)
+                if node.store is not None:
+                    node.store.delete(oid)
+                elif node.agent_conn is not None:
+                    try:
+                        node.agent_conn.send(P.AGENT_OBJ_FREE, [ob])
+                    except P.ConnectionLost:
+                        pass
+
+    # ---- node-store access that works for local and remote nodes ----
+
+    def _node_store_contains(self, node: NodeState, oid: ObjectID) -> bool:
+        if node.store is not None:
+            return node.store.contains(oid)
+        return False  # remote: let the put be idempotent instead
+
+    def _node_store_read(self, node: NodeState, oid: ObjectID):
+        """-> (payload_bytes, meta_bytes) or None."""
+        if node.store is not None:
+            got = node.store.get(oid)
+            if got is None:
+                return None
+            data_v, meta_v = got
+            try:
+                return bytes(data_v), bytes(meta_v)
+            finally:
+                del data_v, meta_v, got
+                node.store.release(oid)
+        payload, meta = node.agent_conn.call(
+            P.AGENT_OBJ_GET, oid.binary(), timeout=120)
+        return None if payload is None else (payload, meta)
+
+    def _node_store_write(self, node: NodeState, oid: ObjectID,
+                          payload: bytes, meta: bytes):
+        if node.store is not None:
+            if node.store.contains(oid):
+                return
+            cfg = get_config()
+            buf = node.store.create(oid, len(payload), len(meta))
+            # chunked copy (mirrors 5 MiB transfer chunks)
+            cs = cfg.object_transfer_chunk_bytes
+            for off in range(0, len(payload), cs):
+                buf[off:off + min(cs, len(payload) - off)] = \
+                    payload[off:off + cs]
+            buf[len(payload):] = meta
+            node.store.seal(oid)
+        else:
+            node.agent_conn.call(P.AGENT_OBJ_PUT, oid.binary(), payload,
+                                 meta, timeout=120)
 
     def _h_object_transfer(self, conn, rid, oid_bin, to_node_idx):
         """Copy an object from its node's arena (or spill file) into
         `to_node_idx`'s arena — the reference's ObjectManager chunked pull
-        (object_manager.cc), collapsed to memcpy within one host."""
+        (object_manager.cc). Within one host this is a memcpy between shm
+        arenas; across hosts the payload rides the head<->agent TCP links.
+
+        Remote transfers block on agent RPCs, and agent replies are
+        delivered by this same head IO thread — so any transfer touching a
+        remote node runs on a side thread (otherwise: deadlock)."""
         oid = ObjectID(oid_bin)
         with self._lock:
             loc = self.objects.get(oid)
         if loc is None:
             conn.reply_error(rid, KeyError(f"object {oid.hex()} unknown"))
             return
-        dst = self.nodes[to_node_idx].store
-        if dst.contains(oid):
-            conn.reply(rid, True)
+        dst_node = self.nodes[to_node_idx]
+        src_node = self.nodes.get(loc.node_idx)
+        if dst_node.is_remote or (src_node is not None
+                                  and src_node.is_remote):
+            threading.Thread(
+                target=self._do_object_transfer,
+                args=(conn, rid, oid, loc, dst_node), daemon=True).start()
             return
-        cfg = get_config()
-        if loc.spilled_path:
-            with open(loc.spilled_path, "rb") as f:
-                data = f.read()
-            # spill file layout: [8B meta_len][meta][payload]
-            meta_len = int.from_bytes(data[:8], "little")
-            meta = data[8:8 + meta_len]
-            payload = data[8 + meta_len:]
-            buf = dst.create(oid, len(payload), len(meta))
-            buf[:len(payload)] = payload
-            buf[len(payload):] = meta
-            dst.seal(oid)
-        else:
-            src = self.nodes[loc.node_idx].store
-            got = src.get(oid)
-            if got is None:
-                conn.reply_error(rid, KeyError(f"object {oid.hex()} gone"))
+        self._do_object_transfer(conn, rid, oid, loc, dst_node)
+
+    def _do_object_transfer(self, conn, rid, oid, loc, dst_node):
+        try:
+            if self._node_store_contains(dst_node, oid):
+                conn.reply(rid, True)
                 return
-            data_v, meta_v = got
+            if loc.spilled_path:
+                with open(loc.spilled_path, "rb") as f:
+                    data = f.read()
+                # spill file layout: [8B meta_len][meta][payload]
+                meta_len = int.from_bytes(data[:8], "little")
+                meta = data[8:8 + meta_len]
+                payload = data[8 + meta_len:]
+            else:
+                got = self._node_store_read(self.nodes[loc.node_idx], oid)
+                if got is None:
+                    conn.reply_error(
+                        rid, KeyError(f"object {oid.hex()} gone"))
+                    return
+                payload, meta = got
+            self._node_store_write(dst_node, oid, payload, meta)
+            conn.reply(rid, True)
+        except P.ConnectionLost:
+            pass
+        except Exception as e:  # noqa: BLE001 — surface to the requester
             try:
-                buf = dst.create(oid, len(data_v), len(meta_v))
-                # chunked copy (mirrors 5 MiB transfer chunks)
-                cs = cfg.object_transfer_chunk_bytes
-                for off in range(0, len(data_v), cs):
-                    buf[off:off + min(cs, len(data_v) - off)] = \
-                        data_v[off:off + cs]
-                buf[len(data_v):] = meta_v
-                dst.seal(oid)
-            finally:
-                del data_v, meta_v, got
-                src.release(oid)
-        conn.reply(rid, True)
+                conn.reply_error(rid, e)
+            except P.ConnectionLost:
+                pass
 
     # --------------------------------------------------------- spilling
 
@@ -922,8 +1107,8 @@ class Head:
         local_object_manager.h:110; FileSystemStorage external_storage.py)."""
         cfg = get_config()
         node = self.nodes.get(node_idx)
-        if node is None:
-            return
+        if node is None or node.store is None:
+            return  # remote nodes spill locally (agent-side), not via head
         store = node.store
         if store.bytes_in_use() < cfg.object_spilling_threshold * \
                 store.capacity():
@@ -1015,6 +1200,7 @@ class Head:
             self._forward_to_worker(owner, P.BORROW_ADD, oid, borrower),
         P.BORROW_REMOVE: lambda self, conn, rid, oid, owner, borrower:
             self._forward_to_worker(owner, P.BORROW_REMOVE, oid, borrower),
+        P.REGISTER_NODE: _h_register_node,
     }
 
     def _forward_to_worker(self, worker_id: str, mt: int, *fields):
@@ -1070,9 +1256,18 @@ class Head:
             self._listener.close()
         except OSError:
             pass
+        if self._tcp_listener is not None:
+            try:
+                self._tcp_listener.close()
+            except OSError:
+                pass
         for n in self.nodes.values():
             try:
-                n.store.close()
+                if n.store is not None:
+                    n.store.close()
+                if n.agent_conn is not None:
+                    n.agent_conn.on_close = None
+                    n.agent_conn.close()
             except Exception:
                 pass
         self.nodes.clear()

@@ -1,0 +1,208 @@
+"""Node agent: joins a remote host to a head over TCP.
+
+Ref analog: the raylet (src/ray/raylet/main.cc:113 — per-node daemon that
+registers with the GCS, owns the local object store, and forks workers).
+Re-designed small: the head keeps all scheduling state; the agent only
+(1) creates the host-local shm object store, (2) forks/kills workers on
+demand, (3) serves object reads/writes so the head can move objects
+between hosts over the TCP control links.
+
+Run:  python -m ray_tpu.core.node_agent --address tcp:HEAD_IP:PORT \
+          [--num-cpus N] [--num-tpus N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Dict
+
+from . import protocol as P
+from .config import get_config
+from .ids import ObjectID
+from .object_store import ShmObjectStore
+from .resources import detect_node_resources
+
+
+def _my_ip(head_host: str, head_port: int) -> str:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((head_host, head_port))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+class NodeAgent:
+    def __init__(self, head_addr: str, *, num_cpus=None, num_tpus=None,
+                 object_store_memory=None, resources=None, labels=None):
+        assert head_addr.startswith("tcp:"), "agents join over tcp:"
+        _, host, port = head_addr.split(":")
+        self.head_addr = head_addr
+        self.node_ip = _my_ip(host, int(port))
+        cfg = get_config()
+        cap = object_store_memory or cfg.object_store_memory
+        self.store_name = f"rtpu_agent_{uuid.uuid4().hex[:10]}"
+        self.store = ShmObjectStore(self.store_name, cap, create=True)
+        self.session_dir = f"/tmp/ray_tpu/agent_{uuid.uuid4().hex[:8]}"
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        self.workers: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+
+        nr = detect_node_resources(num_cpus=num_cpus, num_tpus=num_tpus,
+                                   object_store_memory=cap,
+                                   resources=resources, labels=labels)
+        self.io = P.IOLoop("agent-io")
+        sock = P.connect_addr(head_addr)
+        self.head = P.Connection(sock, peer="head")
+        self.head.on_close = lambda c: self._shutdown.set()
+        self.io.add_connection(self.head, self._on_head_message)
+        self.io.start()
+        reply = self.head.call(P.REGISTER_NODE, nr, self.store_name,
+                               self.node_ip, self.session_dir, timeout=30)
+        self.node_idx, self.session_name = reply[0], reply[1]
+
+    # -------------------------------------------------------- head messages
+
+    def _on_head_message(self, conn: P.Connection, msg):
+        mt, rid = msg[0], msg[1]
+        try:
+            if mt == P.SPAWN_WORKER:
+                self._spawn_worker(msg[2])
+            elif mt == P.KILL_WORKER:
+                self._kill_worker(msg[2])
+            elif mt == P.AGENT_OBJ_GET:
+                oid = ObjectID(msg[2])
+                got = self.store.get(oid)
+                if got is None:
+                    conn.reply(rid, None, b"")
+                else:
+                    data_v, meta_v = got
+                    try:
+                        conn.reply(rid, bytes(data_v), bytes(meta_v))
+                    finally:
+                        del data_v, meta_v, got
+                        self.store.release(oid)
+            elif mt == P.AGENT_OBJ_PUT:
+                oid = ObjectID(msg[2])
+                payload, meta = msg[3], msg[4]
+                if not self.store.contains(oid):
+                    buf = self.store.create(oid, len(payload), len(meta))
+                    buf[:len(payload)] = payload
+                    buf[len(payload):] = meta
+                    self.store.seal(oid)
+                conn.reply(rid, True)
+            elif mt == P.AGENT_OBJ_FREE:
+                for ob in msg[2]:
+                    self.store.delete(ObjectID(ob))
+            elif mt == P.PING:
+                conn.reply(rid, True)
+        except Exception as e:  # noqa: BLE001
+            if rid > 0:
+                conn.reply_error(rid, e)
+
+    # ------------------------------------------------------------- workers
+
+    def _spawn_worker(self, worker_id: str):
+        env = dict(os.environ)
+        import ray_tpu
+
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.abspath(ray_tpu.__file__)))
+        entries = [p for p in sys.path if p] + [pkg_parent]
+        pp = env.get("PYTHONPATH", "")
+        have = set(pp.split(os.pathsep)) if pp else set()
+        add = [p for p in entries if p not in have]
+        if add:
+            env["PYTHONPATH"] = os.pathsep.join(add + ([pp] if pp else []))
+        env.update({
+            "RAY_TPU_WORKER_ID": worker_id,
+            "RAY_TPU_HEAD_ADDR": self.head_addr,
+            "RAY_TPU_NODE_IDX": str(self.node_idx),
+            "RAY_TPU_SESSION_DIR": self.session_dir,
+            "RAY_TPU_NODE_IP": self.node_ip,
+            "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+        })
+        if env["JAX_PLATFORMS"] == "cpu":
+            # see head._spawn_worker: the axon sitecustomize must not load
+            # in CPU-only workers
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        log_dir = os.path.join(self.session_dir, "logs")
+        out = open(os.path.join(log_dir, f"worker-{worker_id[:8]}.out"),
+                   "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env, stdout=out, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        with self._lock:
+            self.workers[worker_id] = proc
+
+    def _kill_worker(self, worker_id: str):
+        with self._lock:
+            proc = self.workers.pop(worker_id, None)
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------ lifecycle
+
+    def run_forever(self):
+        try:
+            while not self._shutdown.wait(0.5):
+                pass
+        finally:
+            self.shutdown()
+
+    def shutdown(self):
+        self._shutdown.set()
+        with self._lock:
+            procs = list(self.workers.values())
+            self.workers.clear()
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+        try:
+            self.head.close()
+        except Exception:
+            pass
+        self.io.stop()
+        try:
+            self.store.close()
+        except Exception:
+            pass
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="ray_tpu node agent")
+    ap.add_argument("--address", required=True,
+                    help="head address, tcp:HOST:PORT")
+    ap.add_argument("--num-cpus", type=int, default=None)
+    ap.add_argument("--num-tpus", type=int, default=None)
+    ap.add_argument("--object-store-memory", type=int, default=None)
+    args = ap.parse_args(argv)
+    agent = NodeAgent(args.address, num_cpus=args.num_cpus,
+                      num_tpus=args.num_tpus,
+                      object_store_memory=args.object_store_memory)
+    print(f"node agent joined as node {agent.node_idx} "
+          f"(store {agent.store_name})", flush=True)
+    signal.signal(signal.SIGTERM, lambda *a: agent._shutdown.set())
+    agent.run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -63,6 +63,16 @@ JOB_SUBMIT = 36
 PING = 37
 OK = 38
 
+# head <-> node agent (remote-host membership; the reference's raylet
+# registration over gRPC, src/ray/gcs/gcs_server gcs_node_manager)
+REGISTER_NODE = 39        # (node_resources, store_name, node_ip, session_dir)
+REGISTER_NODE_REPLY = 40  # (node_idx, session_name)
+SPAWN_WORKER = 41         # head->agent: (worker_id,)
+KILL_WORKER = 42          # head->agent: (worker_id,)
+AGENT_OBJ_GET = 43        # head->agent: (oid_bin) -> (payload, meta) | error
+AGENT_OBJ_PUT = 44        # head->agent: (oid_bin, payload, meta)
+AGENT_OBJ_FREE = 45       # head->agent: (oid_bins,)
+
 # worker <-> worker (direct transport)
 PUSH_TASK = 50          # (task_spec_bytes, seqno)
 TASK_REPLY = 51         # (task_id_bin, status, result_meta, err)  [rpc reply]
@@ -314,6 +324,15 @@ def listen_unix(path: str) -> socket.socket:
         pass
     s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     s.bind(path)
+    s.listen(128)
+    return s
+
+
+def listen_tcp(host: str = "0.0.0.0", port: int = 0) -> socket.socket:
+    """TCP listener for cross-host membership (DCN control plane)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, port))
     s.listen(128)
     return s
 

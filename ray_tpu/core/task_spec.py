@@ -64,6 +64,10 @@ class TaskSpec:
     max_concurrency: int = 1
     # options
     runtime_env: Optional[dict] = None
+    # chip assignment stamped by the head at lease grant (the reference's
+    # CUDA_VISIBLE_DEVICES resource-instance ids; exported to the task as
+    # TPU_VISIBLE_CHIPS)
+    tpu_ids: Optional[List[int]] = None
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_return(self.task_id, i + 1)

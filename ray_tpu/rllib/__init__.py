@@ -1,0 +1,30 @@
+"""ray_tpu.rllib — reinforcement learning on the ray_tpu runtime.
+
+TPU-first re-design of the reference's RLlib (SURVEY.md §2.4; rllib/):
+CPU rollout-worker actors step native vectorized envs; JAX learners run
+the whole SGD step as one jitted XLA program on the accelerator; PPO is
+the synchronous on-policy algorithm, IMPALA the asynchronous V-trace one.
+Algorithms are Tune Trainables, so ``Tuner(PPO, param_space=...)`` works.
+
+    from ray_tpu.rllib import PPOConfig
+    algo = PPOConfig().environment("CartPole-v1").build()
+    for _ in range(10):
+        print(algo.train()["episode_reward_mean"])
+"""
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .env import CartPole, Env, VectorEnv, make_env, register_env
+from .impala import IMPALA, IMPALAConfig
+from .learner import ImpalaLearner, LearnerGroup, PPOLearner, vtrace
+from .policy import JaxPolicy
+from .ppo import PPO, PPOConfig
+from .rollout_worker import RolloutWorker
+from .sample_batch import SampleBatch, compute_gae, concat_samples
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
+    "IMPALAConfig", "Env", "CartPole", "VectorEnv", "make_env",
+    "register_env", "JaxPolicy", "RolloutWorker", "SampleBatch",
+    "concat_samples", "compute_gae", "PPOLearner", "ImpalaLearner",
+    "LearnerGroup", "vtrace",
+]

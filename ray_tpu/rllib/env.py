@@ -1,0 +1,177 @@
+"""Environments: a minimal Env protocol, classic-control tasks, VectorEnv.
+
+Ref analogs: rllib/env/base_env.py + env/vector_env.py (the reference wraps
+gym; this image has no gym, so the classic CartPole dynamics are implemented
+directly — same physics constants as gym's cartpole.py, which are public
+textbook values from Barto, Sutton & Anderson 1983).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class Env:
+    """Single environment: reset() -> obs; step(a) -> (obs, r, done, info)."""
+
+    observation_dim: int
+    num_actions: int
+    max_episode_steps: int = 1000
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool, dict]:
+        raise NotImplementedError
+
+
+class CartPole(Env):
+    """Pole balancing; solved threshold 475 (v1 cap 500)."""
+
+    observation_dim = 4
+    num_actions = 2
+    max_episode_steps = 500
+
+    GRAVITY = 9.8
+    MASSCART = 1.0
+    MASSPOLE = 0.1
+    LENGTH = 0.5  # half pole length
+    FORCE_MAG = 10.0
+    TAU = 0.02
+    THETA_LIMIT = 12 * 2 * math.pi / 360
+    X_LIMIT = 2.4
+
+    def __init__(self):
+        self._rng = np.random.default_rng(0)
+        self._state = np.zeros(4, np.float32)
+        self._steps = 0
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, 4).astype(np.float32)
+        self._steps = 0
+        return self._state.copy()
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self._state
+        force = self.FORCE_MAG if action == 1 else -self.FORCE_MAG
+        costh, sinth = math.cos(theta), math.sin(theta)
+        total_mass = self.MASSCART + self.MASSPOLE
+        polemass_length = self.MASSPOLE * self.LENGTH
+        temp = (force + polemass_length * theta_dot ** 2 * sinth) / total_mass
+        theta_acc = (self.GRAVITY * sinth - costh * temp) / (
+            self.LENGTH * (4.0 / 3.0 - self.MASSPOLE * costh ** 2
+                           / total_mass))
+        x_acc = temp - polemass_length * theta_acc * costh / total_mass
+        x += self.TAU * x_dot
+        x_dot += self.TAU * x_acc
+        theta += self.TAU * theta_dot
+        theta_dot += self.TAU * theta_acc
+        self._state = np.array([x, x_dot, theta, theta_dot], np.float32)
+        self._steps += 1
+        done = bool(abs(x) > self.X_LIMIT or abs(theta) > self.THETA_LIMIT
+                    or self._steps >= self.max_episode_steps)
+        return self._state.copy(), 1.0, done, {}
+
+
+class StatelessGuess(Env):
+    """Trivial 1-step bandit-ish env for fast unit tests: reward 1 iff the
+    action matches the sign feature of the observation."""
+
+    observation_dim = 2
+    num_actions = 2
+    max_episode_steps = 1
+
+    def __init__(self):
+        self._rng = np.random.default_rng(0)
+        self._obs = np.zeros(2, np.float32)
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        sign = 1.0 if self._rng.random() < 0.5 else -1.0
+        self._obs = np.array([sign, self._rng.random()], np.float32)
+        return self._obs.copy()
+
+    def step(self, action: int):
+        want = 1 if self._obs[0] > 0 else 0
+        r = 1.0 if action == want else 0.0
+        return self.reset(), r, True, {}
+
+
+_REGISTRY: Dict[str, Callable[[], Env]] = {
+    "CartPole-v1": CartPole,
+    "StatelessGuess-v0": StatelessGuess,
+}
+
+
+def register_env(name: str, creator: Callable[[], Env]):
+    """Custom env registration (ref: rllib tune.register_env)."""
+    _REGISTRY[name] = creator
+
+
+def make_env(name_or_creator) -> Env:
+    if callable(name_or_creator):
+        return name_or_creator()
+    try:
+        return _REGISTRY[name_or_creator]()
+    except KeyError:
+        raise KeyError(
+            f"unknown env {name_or_creator!r}; registered: "
+            f"{sorted(_REGISTRY)}") from None
+
+
+class VectorEnv:
+    """N env copies stepped together with auto-reset on done.
+
+    Ref analog: rllib/env/vector_env.py:37 (_VectorizedGymEnv); completed
+    episode returns/lengths are surfaced for metrics.
+    """
+
+    def __init__(self, creator, num_envs: int, seed: int = 0):
+        self.envs: List[Env] = [make_env(creator) for _ in range(num_envs)]
+        self.num_envs = num_envs
+        self.obs = np.stack([e.reset(seed + i)
+                             for i, e in enumerate(self.envs)])
+        self._ep_rew = np.zeros(num_envs, np.float64)
+        self._ep_len = np.zeros(num_envs, np.int64)
+        self.episode_returns: List[float] = []
+        self.episode_lengths: List[int] = []
+
+    @property
+    def observation_dim(self) -> int:
+        return self.envs[0].observation_dim
+
+    @property
+    def num_actions(self) -> int:
+        return self.envs[0].num_actions
+
+    def step(self, actions: np.ndarray):
+        """-> (next_obs [N,D], rewards [N], dones [N])."""
+        obs_out = np.empty_like(self.obs)
+        rews = np.zeros(self.num_envs, np.float32)
+        dones = np.zeros(self.num_envs, np.bool_)
+        for i, env in enumerate(self.envs):
+            o, r, d, _ = env.step(int(actions[i]))
+            self._ep_rew[i] += r
+            self._ep_len[i] += 1
+            if d:
+                self.episode_returns.append(float(self._ep_rew[i]))
+                self.episode_lengths.append(int(self._ep_len[i]))
+                self._ep_rew[i] = 0.0
+                self._ep_len[i] = 0
+                o = env.reset()
+            obs_out[i] = o
+            rews[i] = r
+            dones[i] = d
+        self.obs = obs_out
+        return obs_out.copy(), rews, dones
+
+    def pop_episode_metrics(self) -> Tuple[List[float], List[int]]:
+        rets, lens = self.episode_returns, self.episode_lengths
+        self.episode_returns, self.episode_lengths = [], []
+        return rets, lens

@@ -1,0 +1,75 @@
+"""IMPALA: asynchronous off-policy training with V-trace correction.
+
+Ref analog: rllib/algorithms/impala/impala.py:552 (async sample queue,
+:685 training_step). Re-designed: each rollout worker keeps one in-flight
+``sample_time_major`` future; as futures complete, the learner consumes
+them immediately (off-policy — the batch may be a few updates stale, which
+V-trace corrects) and the worker is restarted with fresh weights. The
+object plane carries the sample batches, exercising worker->learner
+transfer exactly like the reference's aggregation path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import ray_tpu
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .learner import ImpalaLearner
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or IMPALA)
+        self.lr = 5e-4
+        self.grad_clip = 40.0
+        self.clip_rho = 1.0
+        self.clip_c = 1.0
+        self.max_updates_per_step = 8
+
+
+class IMPALA(Algorithm):
+    _config_cls = IMPALAConfig
+
+    def _make_learner_factory(self, cfg, obs_dim, num_actions):
+        def make():
+            return ImpalaLearner(
+                obs_dim, num_actions, lr=cfg.lr, gamma=cfg.gamma,
+                vf_coeff=cfg.vf_coeff, entropy_coeff=cfg.entropy_coeff,
+                grad_clip=cfg.grad_clip, clip_rho=cfg.clip_rho,
+                clip_c=cfg.clip_c, hiddens=cfg.model_hiddens,
+                seed=cfg.seed)
+
+        return make
+
+    def setup(self, config):
+        super().setup(config)
+        # one in-flight rollout per worker, started immediately
+        self._inflight: Dict = {
+            w.sample_time_major.remote(): w for w in self.workers}
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        metrics: dict = {}
+        steps = 0
+        updates = 0
+        while updates < cfg.max_updates_per_step:
+            done, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                   timeout=600)
+            ref = done[0]
+            worker = self._inflight.pop(ref)
+            batch = ray_tpu.get(ref, timeout=600)
+            # learner consumes the (possibly stale) batch; V-trace corrects
+            metrics = self.learners.local.update(batch)
+            updates += 1
+            steps += batch[  # time-major [T, N]
+                "actions"].size
+            # restart the worker with fresh weights
+            worker.set_weights.remote(
+                ray_tpu.put(self.learners.get_weights()))
+            self._inflight[worker.sample_time_major.remote()] = worker
+        self._num_env_steps += steps
+        metrics["env_steps_this_iter"] = steps
+        metrics["updates_this_iter"] = updates
+        return metrics

@@ -1,0 +1,281 @@
+"""Learners: jitted JAX updates (PPO clipped surrogate, IMPALA V-trace).
+
+Ref analogs: rllib/core/learner/learner.py:229 (Learner.update :1230) and
+learner_group.py:61 — re-designed TPU-first: the whole SGD minibatch step
+(forward+backward+adam) is ONE jitted XLA program; a LearnerGroup of N
+learner actors does synchronous data-parallel updates by averaging grads
+(the JAX analog of the reference's TorchDDPRLModule wrapping).
+
+V-trace follows Espeholt et al. 2018 (IMPALA), computed with lax.scan.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import sample_batch as SB
+from .models import entropy_of, forward, init_actor_critic, logp_of
+from .sample_batch import SampleBatch
+
+
+class PPOLearner:
+    """Clipped-surrogate PPO (ref: rllib/algorithms/ppo/ppo_torch_policy.py
+    loss; here one jitted minibatch step)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, *, lr: float = 3e-4,
+                 clip_param: float = 0.2, vf_coeff: float = 0.5,
+                 entropy_coeff: float = 0.01, grad_clip: float = 0.5,
+                 hiddens=(64, 64), seed: int = 0):
+        self.params = init_actor_critic(jax.random.key(seed), obs_dim,
+                                        num_actions, hiddens)
+        self.tx = optax.chain(optax.clip_by_global_norm(grad_clip),
+                              optax.adam(lr))
+        self.opt_state = self.tx.init(self.params)
+
+        def loss_fn(params, batch):
+            logits, values = forward(params, batch[SB.OBS])
+            logp = logp_of(logits, batch[SB.ACTIONS])
+            ratio = jnp.exp(logp - batch[SB.ACTION_LOGP])
+            adv = batch[SB.ADVANTAGES]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv)
+            pi_loss = -surr.mean()
+            vf_loss = jnp.mean((values - batch[SB.VALUE_TARGETS]) ** 2)
+            ent = entropy_of(logits).mean()
+            total = pi_loss + vf_coeff * vf_loss - entropy_coeff * ent
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": ent,
+                           "kl": jnp.mean(batch[SB.ACTION_LOGP] - logp)}
+
+        @jax.jit
+        def train_step(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        @jax.jit
+        def grad_step(params, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            metrics["total_loss"] = loss
+            return grads, metrics
+
+        @jax.jit
+        def apply_grads_step(params, opt_state, grads):
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._train_step = train_step
+        self._grad_step = grad_step
+        self._apply_grads = apply_grads_step
+
+    # ----- local update path -----
+
+    def update(self, batch: SampleBatch, *, num_epochs: int = 4,
+               minibatch_size: int = 128, seed: int = 0) -> dict:
+        rng = np.random.default_rng(seed)
+        metrics = {}
+        dev = {k: jnp.asarray(v) for k, v in batch.items()}
+        for _ in range(num_epochs):
+            shuffled = SampleBatch(batch).shuffle(rng)
+            got_one = False
+            for mb in shuffled.minibatches(minibatch_size):
+                got_one = True
+                self.params, self.opt_state, metrics = self._train_step(
+                    self.params, self.opt_state,
+                    {k: jnp.asarray(v) for k, v in mb.items()})
+            if not got_one:  # batch smaller than one minibatch
+                self.params, self.opt_state, metrics = self._train_step(
+                    self.params, self.opt_state, dev)
+        return {k: float(v) for k, v in metrics.items()}
+
+    # ----- distributed (grad-averaging) path -----
+
+    def compute_grads(self, batch: SampleBatch):
+        grads, metrics = self._grad_step(
+            self.params, {k: jnp.asarray(v) for k, v in batch.items()})
+        return ({k: np.asarray(v) for k, v in grads.items()},
+                {k: float(v) for k, v in metrics.items()})
+
+    def apply_grads(self, grads: Dict[str, np.ndarray]):
+        self.params, self.opt_state = self._apply_grads(
+            self.params, self.opt_state,
+            {k: jnp.asarray(v) for k, v in grads.items()})
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in self.params.items()}
+
+    def set_weights(self, weights: Dict[str, np.ndarray]):
+        self.params = {k: jnp.asarray(v) for k, v in weights.items()}
+
+
+def vtrace(behaviour_logp, target_logp, rewards, dones, values,
+           bootstrap_value, gamma: float, clip_rho: float = 1.0,
+           clip_c: float = 1.0):
+    """V-trace targets (Espeholt et al. 2018, eqs. 1-2), time-major [T, N].
+
+    Returns (vs [T,N], pg_advantages [T,N]).
+    """
+    rho = jnp.minimum(jnp.exp(target_logp - behaviour_logp), clip_rho)
+    c = jnp.minimum(jnp.exp(target_logp - behaviour_logp), clip_c)
+    discounts = gamma * (1.0 - dones.astype(jnp.float32))
+    values_next = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = rho * (rewards + discounts * values_next - values)
+
+    def scan_fn(acc, xs):
+        delta_t, discount_t, c_t = xs
+        acc = delta_t + discount_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        scan_fn, jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, c), reverse=True)
+    vs = values + vs_minus_v
+    vs_next = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = rho * (rewards + discounts * vs_next - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class ImpalaLearner:
+    """V-trace actor-critic learner (ref: rllib/algorithms/impala/)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, *, lr: float = 5e-4,
+                 gamma: float = 0.99, vf_coeff: float = 0.5,
+                 entropy_coeff: float = 0.01, grad_clip: float = 40.0,
+                 clip_rho: float = 1.0, clip_c: float = 1.0,
+                 hiddens=(64, 64), seed: int = 0):
+        self.params = init_actor_critic(jax.random.key(seed), obs_dim,
+                                        num_actions, hiddens)
+        self.tx = optax.chain(optax.clip_by_global_norm(grad_clip),
+                              optax.adam(lr))
+        self.opt_state = self.tx.init(self.params)
+
+        def loss_fn(params, batch):
+            T, N = batch[SB.ACTIONS].shape
+            obs_flat = batch[SB.OBS].reshape(T * N, -1)
+            logits, values = forward(params, obs_flat)
+            logits = logits.reshape(T, N, -1)
+            values = values.reshape(T, N)
+            target_logp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits),
+                batch[SB.ACTIONS][..., None], axis=-1).squeeze(-1)
+            _, bootstrap_value = forward(params, batch["bootstrap_obs"])
+            vs, pg_adv = vtrace(
+                batch[SB.ACTION_LOGP], target_logp, batch[SB.REWARDS],
+                batch[SB.DONES], values, bootstrap_value, gamma,
+                clip_rho, clip_c)
+            pi_loss = -jnp.mean(target_logp * pg_adv)
+            vf_loss = 0.5 * jnp.mean((values - vs) ** 2)
+            ent = entropy_of(logits.reshape(T * N, -1)).mean()
+            total = pi_loss + vf_coeff * vf_loss - entropy_coeff * ent
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": ent}
+
+        @jax.jit
+        def train_step(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        self._train_step = train_step
+
+    def update(self, batch: SampleBatch) -> dict:
+        self.params, self.opt_state, metrics = self._train_step(
+            self.params, self.opt_state,
+            {k: jnp.asarray(v) for k, v in batch.items()})
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in self.params.items()}
+
+    def set_weights(self, weights: Dict[str, np.ndarray]):
+        self.params = {k: jnp.asarray(v) for k, v in weights.items()}
+
+
+class LearnerGroup:
+    """Synchronous data-parallel group over learner actors.
+
+    Ref analog: rllib/core/learner/learner_group.py:61. ``num_learners=0``
+    keeps a single local learner (in-process, owns the accelerator);
+    ``num_learners>=1`` spawns learner actors that compute grads on batch
+    shards, averaged here and applied everywhere (DDP-equivalent update).
+    """
+
+    def __init__(self, make_learner, num_learners: int = 0):
+        import ray_tpu
+
+        self.num_learners = num_learners
+        if num_learners == 0:
+            self.local = make_learner()
+            self.remotes = []
+        else:
+            self.local = make_learner()  # weight source / averaging site
+
+            class _LearnerActor:
+                def __init__(self, payload):
+                    from ray_tpu.core.serialization import loads
+                    self.learner = loads(payload)()
+
+                def compute_grads(self, shard):
+                    return self.learner.compute_grads(shard)
+
+                def set_weights(self, w):
+                    self.learner.set_weights(w)
+
+                def ping(self):
+                    return True
+
+            from ray_tpu.core.serialization import dumps
+
+            payload = dumps(make_learner)
+            cls = ray_tpu.remote(_LearnerActor)
+            self.remotes = [cls.options(num_cpus=0).remote(payload)
+                            for _ in range(num_learners)]
+            w = self.local.get_weights()
+            ray_tpu.get([r.set_weights.remote(w) for r in self.remotes],
+                        timeout=120)
+
+    def update(self, batch: SampleBatch, **kw) -> dict:
+        import ray_tpu
+
+        if not self.remotes:
+            return self.local.update(batch, **kw) \
+                if kw else self.local.update(batch)
+        n = len(self.remotes)
+        size = batch.count // n
+        shards = [batch.slice(i * size, (i + 1) * size) for i in range(n)]
+        outs = ray_tpu.get(
+            [r.compute_grads.remote(s)
+             for r, s in zip(self.remotes, shards)], timeout=300)
+        grads = {k: np.mean([g[k] for g, _ in outs], axis=0)
+                 for k in outs[0][0]}
+        self.local.apply_grads(grads)
+        w = self.local.get_weights()
+        ray_tpu.get([r.set_weights.remote(w) for r in self.remotes],
+                    timeout=120)
+        return outs[0][1]
+
+    def get_weights(self):
+        return self.local.get_weights()
+
+    def set_weights(self, w):
+        import ray_tpu
+
+        self.local.set_weights(w)
+        if self.remotes:
+            ray_tpu.get([r.set_weights.remote(w) for r in self.remotes],
+                        timeout=120)

@@ -1,0 +1,64 @@
+"""Policy networks: plain-JAX MLP actor-critic.
+
+Ref analog: rllib/models/torch/fcnet.py (FullyConnectedNetwork) +
+core/rl_module/rl_module.py:229 — re-designed as a pure function + params
+pytree so the learner update is one jitted XLA program (MXU-friendly
+batched matmuls, no module framework needed at this scale).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, jnp.ndarray]
+
+
+def init_actor_critic(rng, obs_dim: int, num_actions: int,
+                      hiddens: Sequence[int] = (64, 64)) -> Params:
+    params: Params = {}
+    keys = jax.random.split(rng, 2 * len(hiddens) + 2)
+    sizes = [obs_dim, *hiddens]
+    for i in range(len(hiddens)):
+        params[f"w{i}"] = _ortho(keys[2 * i], (sizes[i], sizes[i + 1]),
+                                 gain=jnp.sqrt(2.0))
+        params[f"b{i}"] = jnp.zeros((sizes[i + 1],))
+    params["w_pi"] = _ortho(keys[-2], (sizes[-1], num_actions), gain=0.01)
+    params["b_pi"] = jnp.zeros((num_actions,))
+    params["w_v"] = _ortho(keys[-1], (sizes[-1], 1), gain=1.0)
+    params["b_v"] = jnp.zeros((1,))
+    return params
+
+
+def _ortho(rng, shape, gain: float):
+    a = jax.random.normal(rng, shape)
+    q, r = jnp.linalg.qr(a if shape[0] >= shape[1] else a.T)
+    q = q * jnp.sign(jnp.diag(r))
+    if shape[0] < shape[1]:
+        q = q.T
+    return gain * q[: shape[0], : shape[1]]
+
+
+def forward(params: Params, obs: jnp.ndarray
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """-> (logits [B, A], value [B])."""
+    x = obs
+    # hidden-layer count is static pytree structure, so jit-safe
+    n = sum(1 for k in params if k.startswith("w") and k[1:].isdigit())
+    for i in range(n):
+        x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+    logits = x @ params["w_pi"] + params["b_pi"]
+    value = (x @ params["w_v"] + params["b_v"]).squeeze(-1)
+    return logits, value
+
+
+def logp_of(logits: jnp.ndarray, actions: jnp.ndarray) -> jnp.ndarray:
+    logps = jax.nn.log_softmax(logits)
+    return jnp.take_along_axis(logps, actions[:, None], axis=1).squeeze(-1)
+
+
+def entropy_of(logits: jnp.ndarray) -> jnp.ndarray:
+    logps = jax.nn.log_softmax(logits)
+    return -jnp.sum(jnp.exp(logps) * logps, axis=-1)

@@ -1,0 +1,67 @@
+"""JaxPolicy: jitted action computation + weight transport.
+
+Ref analog: rllib/policy/policy.py:177 (compute_actions, get/set_weights) —
+re-designed: one jitted sample step (forward + categorical sample + logp)
+shared by rollout workers; weights move as numpy pytrees through the object
+store.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .models import forward, init_actor_critic, logp_of
+
+
+class JaxPolicy:
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hiddens=(64, 64), seed: int = 0):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self._rng = jax.random.key(seed)
+        self.params = init_actor_critic(
+            jax.random.key(seed), obs_dim, num_actions, hiddens)
+
+        @jax.jit
+        def _sample(params, obs, rng):
+            logits, value = forward(params, obs)
+            actions = jax.random.categorical(rng, logits)
+            logp = logp_of(logits, actions)
+            return actions, logp, value, logits
+
+        @jax.jit
+        def _greedy(params, obs):
+            logits, value = forward(params, obs)
+            return jnp.argmax(logits, axis=-1), value
+
+        self._sample = _sample
+        self._greedy = _greedy
+
+    def compute_actions(self, obs: np.ndarray, explore: bool = True
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                   np.ndarray]:
+        """-> (actions, logp, vf_preds, logits) as numpy."""
+        obs = jnp.asarray(obs, jnp.float32)
+        if explore:
+            self._rng, sub = jax.random.split(self._rng)
+            a, lp, v, lg = self._sample(self.params, obs, sub)
+        else:
+            a, v = self._greedy(self.params, obs)
+            lp = jnp.zeros_like(v)
+            lg = jnp.zeros((obs.shape[0], self.num_actions))
+        return (np.asarray(a), np.asarray(lp), np.asarray(v),
+                np.asarray(lg))
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        _, v = self._greedy(self.params, jnp.asarray(obs, jnp.float32))
+        return np.asarray(v)
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in self.params.items()}
+
+    def set_weights(self, weights: Dict[str, np.ndarray]):
+        self.params = {k: jnp.asarray(v) for k, v in weights.items()}

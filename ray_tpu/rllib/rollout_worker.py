@@ -1,0 +1,114 @@
+"""RolloutWorker: CPU actor that steps a VectorEnv and emits SampleBatches.
+
+Ref analog: rllib/evaluation/rollout_worker.py:159 (sample :660) — the
+TPU-first split: rollouts stay on host CPUs as plain actors; only the
+learner touches the accelerator. GAE postprocessing runs here so learners
+receive ready-to-optimize batches (ref: evaluation/postprocessing.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import sample_batch as SB
+from .env import VectorEnv
+from .policy import JaxPolicy
+from .sample_batch import SampleBatch, compute_gae
+
+
+class RolloutWorker:
+    def __init__(self, env_creator, num_envs: int, rollout_len: int,
+                 gamma: float, lam: float, hiddens=(64, 64),
+                 seed: int = 0, worker_idx: int = 0):
+        self.vec = VectorEnv(env_creator, num_envs, seed=seed * 1000 + 17)
+        self.policy = JaxPolicy(self.vec.observation_dim,
+                                self.vec.num_actions, hiddens,
+                                seed=seed)
+        self.rollout_len = rollout_len
+        self.gamma = gamma
+        self.lam = lam
+        self.worker_idx = worker_idx
+
+    def sample(self) -> SampleBatch:
+        """Collect one rollout of [T, N] and flatten to [T*N] with GAE."""
+        T, N = self.rollout_len, self.vec.num_envs
+        obs_buf = np.zeros((T, N, self.vec.observation_dim), np.float32)
+        act_buf = np.zeros((T, N), np.int64)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.bool_)
+        logp_buf = np.zeros((T, N), np.float32)
+        vf_buf = np.zeros((T, N), np.float32)
+        logits_buf = np.zeros((T, N, self.vec.num_actions), np.float32)
+
+        obs = self.vec.obs
+        for t in range(T):
+            actions, logp, vf, logits = self.policy.compute_actions(obs)
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            logp_buf[t] = logp
+            vf_buf[t] = vf
+            logits_buf[t] = logits
+            obs, rewards, dones = self.vec.step(actions)
+            rew_buf[t] = rewards
+            done_buf[t] = dones
+
+        last_value = self.policy.value(obs)
+        adv, targets = compute_gae(rew_buf, vf_buf, done_buf, last_value,
+                                   self.gamma, self.lam)
+        flat = lambda x: x.reshape((T * N,) + x.shape[2:])  # noqa: E731
+        return SampleBatch({
+            SB.OBS: flat(obs_buf),
+            SB.ACTIONS: flat(act_buf),
+            SB.REWARDS: flat(rew_buf),
+            SB.DONES: flat(done_buf),
+            SB.ACTION_LOGP: flat(logp_buf),
+            SB.VF_PREDS: flat(vf_buf),
+            SB.BEHAVIOUR_LOGITS: flat(logits_buf),
+            SB.ADVANTAGES: flat(adv),
+            SB.VALUE_TARGETS: flat(targets),
+        })
+
+    def sample_time_major(self) -> SampleBatch:
+        """[T, N]-shaped batch (IMPALA/V-trace needs the time axis)."""
+        T, N = self.rollout_len, self.vec.num_envs
+        obs_buf = np.zeros((T, N, self.vec.observation_dim), np.float32)
+        act_buf = np.zeros((T, N), np.int64)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.bool_)
+        logp_buf = np.zeros((T, N), np.float32)
+
+        obs = self.vec.obs
+        for t in range(T):
+            actions, logp, _, _ = self.policy.compute_actions(obs)
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            logp_buf[t] = logp
+            obs, rewards, dones = self.vec.step(actions)
+            rew_buf[t] = rewards
+            done_buf[t] = dones
+
+        return SampleBatch({
+            SB.OBS: obs_buf,
+            SB.ACTIONS: act_buf,
+            SB.REWARDS: rew_buf,
+            SB.DONES: done_buf,
+            SB.ACTION_LOGP: logp_buf,
+            "bootstrap_obs": obs.copy(),
+        })
+
+    # ---- weight sync / metrics ----
+
+    def set_weights(self, weights: Dict[str, np.ndarray]):
+        self.policy.set_weights(weights)
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        return self.policy.get_weights()
+
+    def episode_metrics(self) -> dict:
+        rets, lens = self.vec.pop_episode_metrics()
+        return {"episode_returns": rets, "episode_lengths": lens}
+
+    def ping(self) -> bool:
+        return True

@@ -71,6 +71,9 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: Optional[FailureConfig] = None
     checkpoint_config: Optional[CheckpointConfig] = None
+    # Tune stop criteria: dict (metric bounds / training_iteration) or
+    # callable(trial_id, result) -> bool (ref: air/config.py RunConfig.stop)
+    stop: Optional[Any] = None
     verbose: int = 1
 
     def resolved_storage_path(self) -> str:

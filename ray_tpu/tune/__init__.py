@@ -29,6 +29,8 @@ from .search import (
     sample_from,
     uniform,
 )
+from ray_tpu.train.config import CheckpointConfig, FailureConfig, RunConfig
+
 from .session import get_checkpoint, report
 from .trainable import FunctionTrainable, Trainable, with_parameters
 from .trial import Trial
@@ -36,6 +38,7 @@ from .tuner import TuneConfig, Tuner, run
 
 __all__ = [
     "Tuner", "TuneConfig", "run", "ResultGrid", "Trial",
+    "RunConfig", "CheckpointConfig", "FailureConfig",
     "Trainable", "FunctionTrainable", "with_parameters",
     "report", "get_checkpoint",
     "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",

@@ -1,0 +1,1 @@
+"""ray_tpu.utils — shared utilities and benchmark harnesses."""

@@ -1,0 +1,28 @@
+import time
+import ray_tpu
+
+def main():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    from ray_tpu.rllib import PPOConfig
+    algo = PPOConfig().environment("CartPole-v1").rollouts(
+        num_rollout_workers=2, num_envs_per_worker=4,
+        rollout_fragment_length=64,
+    ).training(lr=1e-3, entropy_coeff=0.003, num_sgd_iter=8, grad_clip=10.0, sgd_minibatch_size=128).debugging(seed=0).build()
+    for i in range(120):
+        t0 = time.perf_counter()
+        r = algo.train()
+        rew = r.get("episode_reward_mean", 0)
+        if i % 10 == 0 or rew >= 150: print(f"iter {i}: reward={rew:.1f}")
+        if rew >= 150: break
+    # time the pieces
+    t0 = time.perf_counter(); batches = ray_tpu.get([w.sample.remote() for w in algo.workers], timeout=600); t1 = time.perf_counter()
+    from ray_tpu.rllib.sample_batch import concat_samples
+    b = concat_samples(batches)
+    t2 = time.perf_counter(); algo.learners.update(b, num_epochs=6, minibatch_size=128); t3 = time.perf_counter()
+    t4 = time.perf_counter(); algo.__class__._sync_weights(algo); t5 = time.perf_counter()
+    print(f"sample={t1-t0:.2f}s update={t3-t2:.2f}s sync={t5-t4:.2f}s")
+    algo.stop()
+    ray_tpu.shutdown()
+
+if __name__ == "__main__":
+    main()
