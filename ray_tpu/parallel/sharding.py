@@ -79,7 +79,9 @@ def with_logical_constraint(x, logical: Sequence[Optional[str]], rules=None,
     """`lax.with_sharding_constraint` in logical-axis vocabulary.
 
     No-op outside a mesh context so model code runs un-meshed (single chip,
-    unit tests) unchanged.
+    unit tests) unchanged. Pass ``mesh=`` explicitly (as the model code
+    does); only `jax.set_mesh` / `jax.sharding.use_mesh` contexts are
+    auto-detected — the legacy ``with mesh:`` context manager is not.
     """
     mesh = mesh or _current_mesh()
     if mesh is None or mesh.empty:
@@ -90,20 +92,9 @@ def with_logical_constraint(x, logical: Sequence[Optional[str]], rules=None,
 
 def _current_mesh() -> Optional[Mesh]:
     try:
-        m = jax.sharding.get_abstract_mesh()  # jax>=0.4.35
+        m = jax.sharding.get_abstract_mesh()  # jax>=0.4.35, set via set_mesh
         if m is not None and not m.empty:
-            # Abstract mesh from `jax.set_mesh`/use_mesh context.
             return m
-    except Exception:
-        pass
-    try:
-        # jax.interpreters.pxla.thread_resources is deprecated; the private
-        # mesh_lib path is the non-deprecated home of the same thread-local.
-        from jax._src import mesh as _mesh_lib
-
-        env_mesh = _mesh_lib.thread_resources.env.physical_mesh
-        if env_mesh is not None and not env_mesh.empty:
-            return env_mesh
     except Exception:
         pass
     return None

@@ -200,7 +200,10 @@ def shutdown():
         reset_routers()
         return
     try:
-        ray_tpu.get(ctrl.shutdown_serve.remote(), timeout=30)
+        # Generous timeout: shutdown_serve joins every in-flight replica
+        # drain (graceful_shutdown_timeout_s each, run concurrently) before
+        # returning; killing the controller early would orphan them.
+        ray_tpu.get(ctrl.shutdown_serve.remote(), timeout=120)
     except Exception:
         pass
     ray_tpu.kill(ctrl)

@@ -92,6 +92,12 @@ class ServeController:
         self._apps: Dict[str, dict] = {}
         self._routing_version = 0
         self._shutdown = False
+        # every replica drain ever spawned (scale-downs, rolling updates,
+        # deletes) — shutdown_serve must join ALL of them, not just the
+        # ones it starts, or an in-flight drain dies with the controller
+        # and leaks the replica's worker. Pruned of finished threads as
+        # new drains start.
+        self._drains: List[threading.Thread] = []
         self._thread = threading.Thread(target=self._control_loop,
                                         daemon=True, name="serve-reconcile")
         self._thread.start()
@@ -159,6 +165,13 @@ class ServeController:
                     self._teardown_deployment(dep)
             self._shutdown = True
             self._routing_version += 1
+            drains = list(self._drains)
+        # The caller kills this controller actor right after this returns,
+        # which would orphan any replica whose drain is still in flight —
+        # the replica's worker (and lease) then leaks forever. Wait for
+        # every replica to actually die before reporting shutdown done.
+        for t in drains:
+            t.join(timeout=30)
         return True
 
     def _teardown_deployment(self, dep: _DeploymentState):
@@ -239,6 +252,14 @@ class ServeController:
 
     def _reconcile_deployment(self, dep: _DeploymentState):
         with self._lock:
+            # The dep was snapshotted outside the lock; shutdown_serve or
+            # delete_app may have torn it down in the window. Reconciling a
+            # stale dep would resurrect replicas nobody tracks or drains.
+            if self._shutdown:
+                return
+            app = self._apps.get(dep.app)
+            if app is None or app["deployments"].get(dep.name) is not dep:
+                return
             self._check_starting(dep)
             self._check_health_and_autoscale(dep)
             self._scale(dep)
@@ -336,7 +357,7 @@ class ServeController:
         desired = min(max(raw, cfg.min_replicas), cfg.max_replicas)
         cur = dep.autoscale_desired
         if desired > cur:
-            self._below_since = None
+            dep._below_since = None
             if dep._above_since is None:
                 dep._above_since = now
             if now - dep._above_since >= cfg.upscale_delay_s:
@@ -402,7 +423,7 @@ class ServeController:
         dep.replicas.append(_Replica(replica_id, handle, dep.version))
 
     def _stop_replica(self, dep: _DeploymentState, r: _Replica,
-                      graceful: bool):
+                      graceful: bool) -> threading.Thread:
         r.state = STOPPING
 
         def _drain(handle=r.handle,
@@ -418,7 +439,12 @@ class ServeController:
             except Exception:
                 pass
 
-        threading.Thread(target=_drain, daemon=True).start()
+        t = threading.Thread(target=_drain, daemon=True)
+        with self._lock:
+            self._drains = [d for d in self._drains if d.is_alive()]
+            self._drains.append(t)
+        t.start()
+        return t
 
     # ----- phase 4: status rollup
 

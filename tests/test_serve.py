@@ -261,6 +261,125 @@ class TestBatching:
             assert o is not None and o.shape == (4,)
 
 
+class TestShutdownReapsReplicas:
+    def test_serve_shutdown_releases_all_workers_and_leases(self, rt):
+        """Regression: serve.shutdown() used to kill the controller while
+        replica drains were still in flight, orphaning replica workers and
+        their leases forever; repeated deploy/shutdown cycles then hit
+        max_workers_per_node and every later deploy timed out."""
+        import ray_tpu.core.api as core_api
+
+        head = core_api._head
+
+        def held():
+            with head._lock:
+                leases = len(head.leases)
+                actors = sum(1 for n in head.nodes.values()
+                             for w in n.workers.values()
+                             if w.state == "actor")
+            return leases, actors
+
+        for _ in range(3):
+            @serve.deployment(num_replicas=2)
+            def echo(x):
+                return x
+
+            h = serve.run(echo.bind(), name="reap")
+            assert h.remote(1).result(timeout_s=30) == 1
+            serve.shutdown()
+        leases, actors = held()
+        assert leases == 0, f"{leases} leases leaked after serve.shutdown"
+        assert actors == 0, f"{actors} actor workers leaked"
+
+
+class TestBatcherUnit:
+    def test_batch_never_exceeds_max_batch_size(self):
+        """Burst submissions must be split into <= max_bs batches (an XLA
+        replica compiled for a padded batch shape cannot take oversized
+        batches). Regression for the leader queue-swap race."""
+        from ray_tpu.serve.batching import _Batcher
+
+        batcher = _Batcher(max_batch_size=4, batch_wait_timeout_s=0.05)
+        sizes = []
+        sizes_lock = threading.Lock()
+
+        def call_batch(items):
+            with sizes_lock:
+                sizes.append(len(items))
+            time.sleep(0.02)  # widen the window where arrivals pile up
+            return [i * 10 for i in items]
+
+        results = [None] * 23
+        threads = [
+            threading.Thread(
+                target=lambda i=i: results.__setitem__(
+                    i, batcher.submit(call_batch, i)))
+            for i in range(23)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert results == [i * 10 for i in range(23)]
+        assert sizes and max(sizes) <= 4, f"oversized batch: {sizes}"
+
+    def test_batch_exception_propagates_to_every_caller(self):
+        from ray_tpu.serve.batching import _Batcher
+
+        batcher = _Batcher(max_batch_size=8, batch_wait_timeout_s=0.05)
+
+        def boom(items):
+            raise RuntimeError("replica exploded")
+
+        errs = [None] * 3
+
+        def call(i):
+            try:
+                batcher.submit(boom, i)
+            except RuntimeError as e:
+                errs[i] = str(e)
+
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert errs == ["replica exploded"] * 3
+
+
+class TestAutoscalePolicyUnit:
+    def test_upscale_episode_resets_downscale_timer(self):
+        """Regression: an upscale used to leave a stale ``_below_since`` on
+        the deployment (the controller cleared its own attribute instead),
+        so a later dip downscaled immediately instead of waiting
+        ``downscale_delay_s``."""
+        from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+        from ray_tpu.serve.controller import ServeController, _DeploymentState
+
+        cfg = AutoscalingConfig(
+            min_replicas=1, max_replicas=4,
+            target_num_ongoing_requests_per_replica=1,
+            upscale_delay_s=0.0, downscale_delay_s=1.5)
+        dep = _DeploymentState(
+            "app", "d", b"", DeploymentConfig(num_replicas=2,
+                                              autoscaling_config=cfg), "v1")
+        dep.autoscale_desired = 2
+        scale = lambda load, now: ServeController._autoscale(  # noqa: E731
+            None, dep, cfg, load, now)
+
+        scale(1, now=0.0)      # below target -> starts the downscale timer
+        assert dep._below_since == 0.0
+        scale(8, now=1.0)      # burst -> upscales (delay 0); timer must reset
+        assert dep.autoscale_desired == 4
+        assert dep._below_since is None
+        scale(1, now=2.0)      # dip right after the upscale episode
+        # with the stale timer this would read 2.0 - 0.0 >= 1.5 and shrink
+        assert dep.autoscale_desired == 4
+        assert dep._below_since == 2.0
+        scale(1, now=4.0)      # genuine sustained dip -> now it may shrink
+        assert dep.autoscale_desired == 1
+
+
 class TestAutoscaling:
     def test_scales_up_under_load_and_down_when_idle(self, serve_session):
         @serve.deployment(
