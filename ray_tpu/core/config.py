@@ -40,11 +40,24 @@ class Config:
     # (reference: scheduler_top_k_fraction).
     scheduler_top_k_fraction: float = 0.2
     # Max tasks in flight pushed to one worker before backpressure.
-    max_tasks_in_flight_per_worker: int = 10
+    # Pipeline depth per leased worker. Deep enough to hide reply latency at
+    # high task rates (the async-task throughput benchmark); the submitter
+    # spreads queued tasks evenly across free workers, so coarse-grained
+    # workloads still parallelize rather than hoarding one worker's pipeline.
+    max_tasks_in_flight_per_worker: int = 40
+    # Rate limit on concurrent lease requests per scheduling class (the
+    # reference's max_pending_lease_requests_per_scheduling_category): the
+    # head queues ungrantable requests, so unbounded requests just churn.
+    max_pending_lease_requests_per_class: int = 10
 
     # --- worker pool ---
     # Max idle workers kept alive per scheduling class.
     idle_worker_keep_alive_s: float = 30.0
+    # Fork CPU-count workers at head start so the first task burst finds an
+    # idle pool (reference: WorkerPool prestart). Interpreter startup is
+    # seconds; paying it mid-workload serializes behind the GIL-bound
+    # driver on small hosts.
+    prestart_workers: bool = True
     # Hard cap on worker processes per node (we run on few cores).
     max_workers_per_node: int = 16
     # Seconds to wait for a worker process to register before failing.

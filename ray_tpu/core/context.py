@@ -99,13 +99,15 @@ class _ActorState:
 
 
 class _InflightTask:
-    __slots__ = ("spec", "arg_ids", "retries_left", "contained_holder")
+    __slots__ = ("spec", "arg_ids", "retries_left", "contained_holder",
+                 "worker")
 
     def __init__(self, spec, arg_ids, retries_left, contained_holder):
         self.spec = spec
         self.arg_ids = arg_ids
         self.retries_left = retries_left
         self.contained_holder = contained_holder  # keeps ObjectRefs alive
+        self.worker: Optional[_LeasedWorker] = None  # set when dispatched
 
 
 class CoreContext:
@@ -131,6 +133,11 @@ class CoreContext:
         # executor / misc state (must exist before any thread starts)
         self.assigned_tpu_ids: List[int] = []
         self._exec_queue: "queue_mod.Queue" = queue_mod.Queue()
+        # coalesced task replies (see run_executor / _flush_pending_replies)
+        self._pending_replies: Dict[P.Connection, list] = {}
+        self._n_pending_replies = 0
+        self._reply_first_ts: Optional[float] = None
+        self._reply_lock = threading.Lock()
         self._actor_instance = None
         self._actor_spec: Optional[TaskSpec] = None
         self._cancelled: set = set()
@@ -197,10 +204,16 @@ class CoreContext:
         mt = msg[0]
         if mt == P.PUSH_TASK:
             self._exec_queue.put((msg[2], conn))
+        elif mt == P.PUSH_TASK_BATCH:
+            for spec in msg[2]:
+                self._exec_queue.put((spec, conn))
         elif mt == P.PUSH_CANCEL:
             self._cancelled.add(TaskID(msg[2]))
         elif mt == P.TASK_REPLY:
             self._handle_task_reply(conn, *msg[2:])
+        elif mt == P.TASK_REPLY_BATCH:
+            for r in msg[2]:
+                self._handle_task_reply(conn, *r)
 
     def _on_head_message(self, conn: P.Connection, msg):
         mt = msg[0]
@@ -285,6 +298,7 @@ class CoreContext:
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None
             ) -> List[Any]:
+        self._flush_pending_replies()
         oids = [r.id for r in refs]
         self._ensure_resolution(refs)
         ready = self.memory_store.wait_ready(oids, len(oids), timeout)
@@ -297,6 +311,7 @@ class CoreContext:
     def wait(self, refs: Sequence[ObjectRef], num_returns: int,
              timeout: Optional[float], fetch_local: bool = True
              ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        self._flush_pending_replies()
         self._ensure_resolution(refs)
         ready_ids = set(self.memory_store.wait_ready(
             [r.id for r in refs], num_returns, timeout))
@@ -489,6 +504,14 @@ class CoreContext:
             self._inflight[spec.task_id] = inflight
             for oid in spec.return_ids():
                 self._return_to_task[oid] = spec.task_id
+            if not holder:
+                # No arg refs → nothing to resolve: queue directly under
+                # the same lock acquisition (the high-rate submission path).
+                st = self._classes.setdefault(cls, _ClassState())
+                st.queue.append(spec)
+        if not holder:
+            self._submit_event.set()
+            return refs
         self._resolve_then(spec, holder,
                            lambda: self._enqueue_ready(spec, cls))
         return refs
@@ -564,41 +587,97 @@ class CoreContext:
                 traceback.print_exc()
 
     def _drain_class(self, cls, st: _ClassState):
+        """Dispatch queued tasks of one scheduling class.
+
+        Policy (replaces the reference's lease-per-task + spillback cycle,
+        direct_task_transport.h:177): aim for one leased worker per queued
+        task up to ``max_workers_per_node`` — the head queues ungrantable
+        lease requests and `_request_lease` hands back grants that arrive
+        after the queue empties. Dispatch fills workers least-loaded-first
+        up to an even share ``T`` of the outstanding work, batching each
+        worker's refill into ONE framed message (one pickle, one syscall),
+        and leaves the remainder queued for leases still in flight — so a
+        burst of a few long tasks spreads across workers while a flood of
+        tiny tasks still pipelines ``max_tasks_in_flight_per_worker`` deep.
+        """
         cfg = get_config()
         cap = cfg.max_tasks_in_flight_per_worker
+        to_release: List[_LeasedWorker] = []
         while True:
             with self._sub_lock:
                 if not st.queue:
                     break
+                total_inflight = sum(len(w.inflight) for w in st.workers)
+                demand = len(st.queue) + total_inflight
+                wanted = min(
+                    min(demand, cfg.max_workers_per_node)
+                    - len(st.workers) - st.pending_leases,
+                    cfg.max_pending_lease_requests_per_class
+                    - st.pending_leases)
+                for _ in range(max(0, wanted)):
+                    st.pending_leases += 1
+                    threading.Thread(
+                        target=self._request_lease, args=(cls, st),
+                        daemon=True).start()
+                if wanted > 0 and not st.workers:
+                    # Starved class: give back idle leases held by OTHER
+                    # classes now, not after the 2s idle reap — their held
+                    # resources are exactly what blocks our lease grants.
+                    for ocls, ost in self._classes.items():
+                        if ocls == cls or ost.queue:
+                            continue
+                        keep = []
+                        for w in ost.workers:
+                            (to_release if not w.inflight
+                             else keep).append(w)
+                        ost.workers = keep
                 worker = None
+                n_free = 0
                 for w in st.workers:
                     if len(w.inflight) < cap:
-                        worker = w
-                        break
+                        n_free += 1
+                        if worker is None or \
+                                len(w.inflight) < len(worker.inflight):
+                            worker = w
                 if worker is None:
-                    demand = len(st.queue)
-                    capacity = len(st.workers) * cap
-                    wanted = min(
-                        (demand - capacity + cap - 1) // cap,
-                        cfg.max_workers_per_node)
-                    need = wanted - st.pending_leases
-                    for _ in range(max(0, need)):
-                        st.pending_leases += 1
-                        threading.Thread(
-                            target=self._request_lease, args=(cls, st),
-                            daemon=True).start()
                     break
-                spec = st.queue.popleft()
-                if spec.task_id in self._cancelled:
-                    self._finish_cancelled(spec)
-                    continue
-                worker.inflight[spec.task_id] = spec
+                # Even share across current free workers AND leases still
+                # pending: don't stuff one pipeline with work a soon-to-
+                # arrive worker could run in parallel.
+                targets = n_free + st.pending_leases
+                share = max(1, (demand + targets - 1) // targets)
+                slots = min(cap, share) - len(worker.inflight)
+                if slots <= 0:
+                    break  # all workers at their share; wait for leases
+                batch = []
+                while st.queue and len(batch) < slots:
+                    spec = st.queue.popleft()
+                    if spec.task_id in self._cancelled:
+                        self._finish_cancelled(spec)
+                        continue
+                    spec.tpu_ids = worker.tpu_ids
+                    worker.inflight[spec.task_id] = spec
+                    inf = self._inflight.get(spec.task_id)
+                    if inf is not None:
+                        inf.worker = worker
+                    batch.append(spec)
                 worker.idle_since = time.monotonic()
-            spec.tpu_ids = worker.tpu_ids
+            if not batch:
+                continue
             try:
-                worker.conn.send(P.PUSH_TASK, spec, 0)
+                if len(batch) == 1:
+                    worker.conn.send(P.PUSH_TASK, batch[0], 0)
+                else:
+                    worker.conn.send(P.PUSH_TASK_BATCH, batch)
             except P.ConnectionLost:
                 self._on_lease_worker_lost(cls, st, worker)
+        for w in to_release:
+            try:
+                self.head.send(P.RETURN_WORKER, w.lease_id, w.worker_id)
+            except P.ConnectionLost:
+                pass
+            w.conn.on_close = None
+            w.conn.close()
 
     def _request_lease(self, cls, st: _ClassState):
         from .serialization import dumps
@@ -621,6 +700,19 @@ class CoreContext:
             with self._sub_lock:
                 st.pending_leases -= 1
             self._fail_queued(st, e)
+            return
+        with self._sub_lock:
+            still_needed = bool(st.queue)
+        if not still_needed:
+            # The queue drained while this lease request was in flight at
+            # the head (it queues ungrantable requests indefinitely) — hand
+            # the worker straight back instead of holding an idle lease.
+            with self._sub_lock:
+                st.pending_leases -= 1
+            try:
+                self.head.send(P.RETURN_WORKER, lease_id, worker_id)
+            except P.ConnectionLost:
+                pass  # shutting down
             return
         try:
             sock = P.connect_addr(addr)
@@ -748,14 +840,17 @@ class CoreContext:
         with self._sub_lock:
             inf = self._inflight.get(task_id)
             spec = inf.spec if inf else None
-            # clear from whichever lease worker carried it
-            for st in self._classes.values():
-                for w in st.workers:
-                    if task_id in w.inflight:
-                        del w.inflight[task_id]
-                        w.idle_since = time.monotonic()
-        if spec is None:
-            # actor task reply
+            # clear from the lease worker that carried it (direct backref —
+            # scanning every worker of every class is O(workers) per reply)
+            w = inf.worker if inf is not None else None
+            if w is not None:
+                w.inflight.pop(task_id, None)
+                w.idle_since = time.monotonic()
+                inf.worker = None
+        if spec is None or spec.task_type == TaskType.ACTOR_TASK:
+            # Actor replies must ALSO clear the actor state's inflight map,
+            # or a completed call lingers there and is replayed (or failed)
+            # when the actor restarts.
             self._handle_actor_reply(task_id, status, result_meta, err)
             return
         if status == "ok":
@@ -1030,6 +1125,7 @@ class CoreContext:
             try:
                 item = self._exec_queue.get(timeout=1.0)
             except queue_mod.Empty:
+                self._flush_pending_replies()
                 continue
             if item is None:
                 break
@@ -1038,6 +1134,7 @@ class CoreContext:
             if (aspec is not None and aspec.max_concurrency > 1
                     and spec.task_type == TaskType.ACTOR_TASK
                     and spec.method_name != "__ray_terminate__"):
+                self._flush_pending_replies()
                 if pool is None:
                     import concurrent.futures as cf
 
@@ -1054,15 +1151,67 @@ class CoreContext:
                     # where terminate queues behind pending tasks).
                     pool.shutdown(wait=True)
                     pool = None
-                self._execute_safe(spec, conn)
+                # Age-bound batching: a reply is withheld only while MORE
+                # work is queued AND for at most ~1ms — so back-to-back
+                # microsecond tasks coalesce into one frame, but a long
+                # task never holds an earlier task's finished result
+                # hostage (the caller may need it to unblock that very
+                # task).
+                if self._reply_age_exceeded(0.001):
+                    self._flush_pending_replies()
+                reply = self._execute_guarded(spec, conn)
+                if reply is not None:
+                    with self._reply_lock:
+                        self._pending_replies.setdefault(
+                            conn, []).append(reply)
+                        self._n_pending_replies += 1
+                        if self._reply_first_ts is None:
+                            self._reply_first_ts = time.monotonic()
+                if self._n_pending_replies >= 64 or \
+                        self._exec_queue.qsize() == 0:
+                    self._flush_pending_replies()
+
+    def _reply_age_exceeded(self, age_s: float) -> bool:
+        ts = self._reply_first_ts
+        return ts is not None and time.monotonic() - ts > age_s
+
+    def _flush_pending_replies(self):
+        """Send all coalesced task replies. Also called from get()/wait()
+        (a task nested-blocking on its own driver must not strand earlier
+        results) and from _graceful_exit (replies must beat os._exit)."""
+        with self._reply_lock:
+            if not self._n_pending_replies:
+                return
+            pending = self._pending_replies
+            self._pending_replies = {}
+            self._n_pending_replies = 0
+            self._reply_first_ts = None
+        for conn, replies in pending.items():
+            try:
+                if len(replies) == 1:
+                    conn.send(P.TASK_REPLY, *replies[0])
+                else:
+                    conn.send(P.TASK_REPLY_BATCH, replies)
+            except P.ConnectionLost:
+                pass
 
     def _execute_safe(self, spec: TaskSpec, conn: P.Connection):
+        """Pool-path execution: send the reply immediately."""
+        reply = self._execute_guarded(spec, conn)
+        if reply is not None:
+            try:
+                conn.send(P.TASK_REPLY, *reply)
+            except P.ConnectionLost:
+                pass
+
+    def _execute_guarded(self, spec: TaskSpec, conn: P.Connection):
         try:
-            self._execute(spec, conn)
+            return self._execute(spec, conn)
         except P.ConnectionLost:
             pass
         except Exception:
             traceback.print_exc()
+        return None
 
     def _decode_args(self, spec: TaskSpec):
         vals = []
@@ -1083,10 +1232,10 @@ class CoreContext:
         return pos, kwargs
 
     def _execute(self, spec: TaskSpec, conn: P.Connection):
+        """Run one task; returns the TASK_REPLY fields (or None when the
+        reply was already sent inline — creation/terminate paths)."""
         if spec.task_id in self._cancelled:
-            conn.send(P.TASK_REPLY, spec.task_id.binary(), "cancelled", None,
-                      None)
-            return
+            return (spec.task_id.binary(), "cancelled", None, None)
         self.current_task_id = spec.task_id
         if spec.tpu_ids is not None:
             # Export the head-assigned chips before user code imports JAX
@@ -1105,7 +1254,7 @@ class CoreContext:
                     self.kv_put("named_actor", spec.name,
                                 spec.actor_id.binary(), True)
                 conn.send(P.TASK_REPLY, spec.task_id.binary(), "ok", [], None)
-                return
+                return None
             if spec.task_type == TaskType.ACTOR_TASK:
                 if self._actor_instance is None:
                     raise RuntimeError("actor not initialized")
@@ -1113,7 +1262,7 @@ class CoreContext:
                     conn.send(P.TASK_REPLY, spec.task_id.binary(), "ok",
                               [("v", serialize(None).frames)], None)
                     self._graceful_exit()
-                    return
+                    return None
                 fn = getattr(self._actor_instance, spec.method_name)
                 args, kwargs = self._decode_args(spec)
                 result = self._call(fn, args, kwargs)
@@ -1123,25 +1272,24 @@ class CoreContext:
                 result = self._call(fn, args, kwargs)
         except Exception as e:  # noqa: BLE001
             te = TaskError(repr(e), traceback.format_exc(), e)
-            try:
-                conn.send(P.TASK_REPLY, spec.task_id.binary(), "error", None,
-                          te)
-            except P.ConnectionLost:
-                pass
             if spec.task_type == TaskType.ACTOR_CREATION:
+                try:
+                    conn.send(P.TASK_REPLY, spec.task_id.binary(), "error",
+                              None, te)
+                except P.ConnectionLost:
+                    pass
                 try:
                     self.head.send(P.ACTOR_DEAD, spec.actor_id.binary(),
                                    repr(e))
                 finally:
                     os._exit(1)
-            return
+            return (spec.task_id.binary(), "error", None, te)
         try:
             result_meta = self._encode_results(spec, result)
         except Exception as e:  # noqa: BLE001 — e.g. unserializable return
             te = TaskError(repr(e), traceback.format_exc(), None)
-            conn.send(P.TASK_REPLY, spec.task_id.binary(), "error", None, te)
-            return
-        conn.send(P.TASK_REPLY, spec.task_id.binary(), "ok", result_meta, None)
+            return (spec.task_id.binary(), "error", None, te)
+        return (spec.task_id.binary(), "ok", result_meta, None)
 
     def _call(self, fn, args, kwargs):
         import inspect
@@ -1190,6 +1338,9 @@ class CoreContext:
 
     def _graceful_exit(self):
         self._shutdown = True
+        # Completed-but-coalesced replies must reach their callers before
+        # os._exit, or a succeeded task reads as ActorDiedError.
+        self._flush_pending_replies()
         try:
             self.head.send(P.WORKER_EXIT)
         except P.ConnectionLost:

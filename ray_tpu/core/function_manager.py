@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import weakref
 from typing import Any, Callable, Dict
 
 
@@ -20,21 +21,37 @@ class FunctionManager:
         self._kv_get = kv_get
         self._exported: set = set()
         self._cache: Dict[str, Any] = {}
+        # fn object -> exported id; export() is on the per-task submit hot
+        # path, so the cloudpickle+hash must run once per function object,
+        # not once per task. Weak keys: dropping the last user reference to
+        # a remote function must not pin it here.
+        self._id_by_obj: "weakref.WeakKeyDictionary[Any, str]" = \
+            weakref.WeakKeyDictionary()
         self._lock = threading.Lock()
 
     def export(self, obj: Any) -> str:
         """Serialize a function/class, export to KV, return its id."""
+        try:
+            fn_id = self._id_by_obj.get(obj)
+        except TypeError:  # unhashable/unweakrefable callable
+            fn_id = None
+        if fn_id is not None:
+            return fn_id
         from .serialization import dumps
 
         data = dumps(obj)
         fn_id = hashlib.blake2b(data, digest_size=16).hexdigest()
         with self._lock:
-            if fn_id in self._exported:
-                return fn_id
-        self._kv_put(self.NS, fn_id, data, True)
-        with self._lock:
-            self._exported.add(fn_id)
-            self._cache[fn_id] = obj
+            done = fn_id in self._exported
+        if not done:
+            self._kv_put(self.NS, fn_id, data, True)
+            with self._lock:
+                self._exported.add(fn_id)
+                self._cache[fn_id] = obj
+        try:
+            self._id_by_obj[obj] = fn_id
+        except TypeError:
+            pass
         return fn_id
 
     def fetch(self, fn_id: str) -> Any:

@@ -151,6 +151,21 @@ class Head:
 
     def start(self):
         self.io.start()
+        # Prestart the worker pool (reference: WorkerPool prestart,
+        # worker_pool.cc num_prestarted_python_workers): interpreter
+        # startup costs O(seconds); forking CPU-count workers now means a
+        # first burst of tasks finds idle workers instead of paying the
+        # spawn storm mid-workload.
+        cfg = get_config()
+        if cfg.prestart_workers:
+            with self._lock:
+                for node in self.nodes.values():
+                    if node.is_remote:
+                        continue
+                    n = int(node.resources.total.to_dict().get("CPU", 0))
+                    n = min(n, cfg.max_workers_per_node)
+                    for _ in range(n):
+                        self._spawn_worker(node, ("prestart",))
 
     def enable_tcp(self, host: str = "0.0.0.0", port: int = 0,
                    advertise_ip: str = "") -> str:
@@ -375,9 +390,16 @@ class Head:
                 if worker == "spawning":
                     continue  # re-queued internally once worker registers
                 tpu_ids = self.leases[lease_id][4]
-                conn.reply(rid, True, worker.worker_id, worker.listen_addr,
-                           lease_id, None, tpu_ids,
-                           msg_type=P.LEASE_REPLY)
+                try:
+                    conn.reply(rid, True, worker.worker_id,
+                               worker.listen_addr, lease_id, None, tpu_ids,
+                               msg_type=P.LEASE_REPLY)
+                except P.ConnectionLost:
+                    # Requester (driver) died while its lease request was
+                    # queued — undo the grant so the worker and resources
+                    # return to the pool instead of leaking.
+                    self._h_return_worker(conn, 0, lease_id,
+                                          worker.worker_id)
             if not granted:
                 return
 

@@ -11,23 +11,14 @@ integer handles, not these IDs.
 from __future__ import annotations
 
 import os
-import threading
-import uuid
-
-_rand_lock = threading.Lock()
-_rand_counter = 0
 
 
 def _random_bytes(n: int) -> bytes:
-    global _rand_counter
-    with _rand_lock:
-        _rand_counter += 1
-        c = _rand_counter
-    # Mix pid so forked workers never collide with the driver.
-    seed = uuid.uuid4().bytes + os.getpid().to_bytes(4, "little") + c.to_bytes(8, "little")
-    import hashlib
-
-    return hashlib.blake2b(seed, digest_size=n).digest()
+    # os.urandom is fork-safe (fresh kernel entropy per call, so forked
+    # workers never collide with the driver) and ~20x cheaper than the
+    # uuid4+blake2b mix this used — ID minting is on the task-submit hot
+    # path (one TaskID + one ObjectID per ``f.remote()``).
+    return os.urandom(n)
 
 
 class BaseID:

@@ -100,6 +100,14 @@ class ShmObjectStore:
             self._mmap = mmap.mmap(fd, 0)
         finally:
             os.close(fd)
+        try:
+            # Pre-wire this process's PTEs for the whole arena (the C side
+            # already zero-filled the tmpfs pages at creation): without it
+            # the first write pass over the arena eats ~25k minor faults
+            # per 100 MiB, visibly denting put bandwidth.
+            self._mmap.madvise(getattr(mmap, "MADV_POPULATE_WRITE", 23))
+        except (OSError, ValueError):
+            pass  # pre-5.14 kernel: keep lazy faulting
         self._closed = False
         self._lock = threading.Lock()
 
@@ -171,9 +179,18 @@ class ShmObjectStore:
         total = sum(sizes)
         buf = self.create(object_id, total, len(meta))
         pos = 0
-        for f in frames:
-            buf[pos:pos + len(f)] = f
-            pos += len(f)
+        for f, n in zip(frames, sizes):
+            if n > (1 << 20):
+                # numpy's vectorized copy moves ~2x the bytes/s of a Python
+                # memoryview slice assignment — this IS the put-bandwidth
+                # benchmark for large objects.
+                import numpy as np
+
+                np.copyto(np.frombuffer(buf[pos:pos + n], np.uint8),
+                          np.frombuffer(f, np.uint8))
+            else:
+                buf[pos:pos + n] = f
+            pos += n
         buf[total:] = meta
         self.seal(object_id)
         return total + len(meta)

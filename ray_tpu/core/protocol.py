@@ -18,6 +18,7 @@ import pickle
 import socket
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 _LEN = struct.Struct("<I")
@@ -78,6 +79,8 @@ PUSH_TASK = 50          # (task_spec_bytes, seqno)
 TASK_REPLY = 51         # (task_id_bin, status, result_meta, err)  [rpc reply]
 STEAL_BACK = 52
 PUSH_CANCEL = 53        # (task_id_bin, force)
+PUSH_TASK_BATCH = 54    # ([task_specs],) one frame, one pickle, one syscall
+TASK_REPLY_BATCH = 55   # ([(task_id_bin, status, result_meta, err), ...])
 
 
 class ConnectionLost(Exception):
@@ -114,9 +117,47 @@ class Connection:
             if self.closed:
                 raise ConnectionLost(self.peer)
             try:
-                self.sock.sendall(data)
+                self._send_all(data)
             except OSError as e:
                 raise ConnectionLost(f"{self.peer}: {e}") from e
+
+    def _send_all(self, data: bytes, stall_timeout: float = 60.0):
+        """sendall that survives a non-blocking socket (IOLoop registration
+        sets O_NONBLOCK): under send-buffer pressure ``socket.sendall`` can
+        write a PARTIAL frame then raise EAGAIN — the peer then sees a
+        corrupt stream and the message is silently lost. Loop on partial
+        writes, waiting for writability. Caller holds ``_wlock``.
+
+        The stall timeout counts time with NO progress (reset on every
+        accepted byte). On stall the connection is closed before raising —
+        a partial frame is already on the wire, so any later send on this
+        socket would land mid-frame and permanently desync the peer.
+        """
+        import select as _select
+
+        mv = memoryview(data)
+        deadline = time.monotonic() + stall_timeout
+        while mv:
+            try:
+                n = self.sock.send(mv)
+            except BlockingIOError:
+                if time.monotonic() > deadline:
+                    # A partial frame is on the wire; any later send would
+                    # land mid-frame and desync the peer. Kill the stream —
+                    # the IO loop sees EOF and runs the full close path
+                    # (fail pending calls, fire on_close).
+                    try:
+                        self.sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    raise OSError("send stalled: peer not draining")
+                _select.select([], [self.sock], [], 1.0)
+                continue
+            except InterruptedError:
+                continue
+            if n:
+                deadline = time.monotonic() + stall_timeout
+            mv = mv[n:]
 
     def call(self, msg_type: int, *fields, timeout: Optional[float] = None):
         """Send a request and block for its reply; returns reply fields."""

@@ -34,6 +34,9 @@ class TransformerConfig:
     # "auto": ring attention iff mesh's sequence axis > 1, else pallas flash
     # on TPU, else plain XLA attention.
     attention_impl: str = "auto"
+    # Microbatches for pipeline parallelism (mesh pipeline axis > 1);
+    # None -> 2 * n_stages. Bubble fraction is (S-1)/(M+S-1).
+    pipeline_microbatches: Optional[int] = None
 
     @property
     def kv_heads(self) -> int:

@@ -167,7 +167,16 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     if cfg.remat:
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.nothing_saveable)
-    x, _ = jax.lax.scan(lambda c, lp: body(c, lp), x, params["layers"])
+    if mesh is not None and mesh.shape.get("pipeline", 1) > 1:
+        # GPipe-style microbatched stages over the pipeline mesh axis; the
+        # same block body, numerically identical to the plain scan
+        # (parallel/pipeline.py).
+        from ray_tpu.parallel.pipeline import pipeline_scan
+
+        x = pipeline_scan(body, x, params["layers"], mesh,
+                          cfg.pipeline_microbatches)
+    else:
+        x, _ = jax.lax.scan(lambda c, lp: body(c, lp), x, params["layers"])
 
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])

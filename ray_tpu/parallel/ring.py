@@ -32,6 +32,19 @@ _CHECK_KW = ("check_vma" if "check_vma" in
 
 
 def shard_map(f, *, mesh, in_specs, out_specs):
+    try:
+        # Nested use only (e.g. ring attention inside a pipeline stage
+        # body): when the ambient mesh has MANUAL axes we are inside an
+        # enclosing shard_map, and jax requires the inner shard_map to see
+        # that context mesh, not the original concrete one. A plain
+        # `jax.set_mesh` context (all-auto) must NOT override an explicit
+        # mesh argument.
+        am = jax.sharding.get_abstract_mesh()
+        if am is not None and not am.empty and any(
+                t == jax.sharding.AxisType.Manual for t in am.axis_types):
+            mesh = am
+    except Exception:
+        pass
     return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                       **{_CHECK_KW: False})
 

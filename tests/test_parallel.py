@@ -90,3 +90,71 @@ def test_ring_attention_degenerate_single_shard():
     ref = reference_attention(q, q, q)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-4)
+
+
+class TestPipelineParallel:
+    """GPipe-style microbatched pipeline over the `pipeline` mesh axis
+    (parallel/pipeline.py; ref has no in-tree PP — SURVEY.md §2.3)."""
+
+    def test_pipeline_scan_matches_plain_scan(self):
+        from ray_tpu.parallel.pipeline import pipeline_scan
+
+        L, d, B = 8, 16, 8
+        w = jax.random.normal(jax.random.key(0), (L, d, d)) * 0.1
+        x = jax.random.normal(jax.random.key(1), (B, d))
+
+        def body(c, wl):
+            return jnp.tanh(c @ wl), None
+
+        ref, _ = jax.lax.scan(body, x, w)
+        mesh = make_mesh(pipeline=4, fsdp=1)
+        out = jax.jit(
+            lambda w, x: pipeline_scan(body, x, w, mesh,
+                                       num_microbatches=4))(w, x)
+        np.testing.assert_allclose(ref, out, atol=1e-5)
+
+    def test_pipeline_grad_matches_plain_scan(self):
+        from ray_tpu.parallel.pipeline import pipeline_scan
+
+        L, d, B = 4, 8, 4
+        w = jax.random.normal(jax.random.key(0), (L, d, d)) * 0.1
+        x = jax.random.normal(jax.random.key(1), (B, d))
+
+        def body(c, wl):
+            return jnp.tanh(c @ wl), None
+
+        def loss_ref(w):
+            y, _ = jax.lax.scan(body, x, w)
+            return (y ** 2).mean()
+
+        mesh = make_mesh(pipeline=2, fsdp=1)
+
+        def loss_pp(w):
+            return (pipeline_scan(body, x, w, mesh, 4) ** 2).mean()
+
+        g_ref = jax.grad(loss_ref)(w)
+        g_pp = jax.jit(jax.grad(loss_pp))(w)
+        np.testing.assert_allclose(g_ref, g_pp, atol=1e-5)
+
+    def test_transformer_forward_pipelined_parity(self):
+        """Full model: pipeline=2 x tensor=2 x data=2 mesh vs un-meshed."""
+        from ray_tpu.models import forward, init_params
+        from ray_tpu.models.config import TransformerConfig
+        from ray_tpu.parallel.sharding import tree_shardings
+        from ray_tpu.models.transformer import param_logical_axes
+
+        cfg = TransformerConfig(
+            vocab_size=128, d_model=32, n_layers=4, n_heads=4, d_ff=64,
+            dtype=jnp.float32, param_dtype=jnp.float32, remat=False,
+            attention_impl="xla", pipeline_microbatches=4)
+        params = init_params(jax.random.key(0), cfg)
+        tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, 128)
+
+        ref = forward(params, tokens, cfg)
+
+        mesh = make_mesh(data=2, pipeline=2, tensor=2)
+        shardings = tree_shardings(mesh, param_logical_axes(cfg))
+        params_sharded = jax.device_put(params, shardings)
+        out = jax.jit(
+            lambda p, t: forward(p, t, cfg, mesh))(params_sharded, tokens)
+        np.testing.assert_allclose(ref, out, atol=1e-4, rtol=1e-4)
