@@ -72,12 +72,13 @@ class _LeasedWorker:
 
 
 class _ClassState:
-    __slots__ = ("queue", "workers", "pending_leases")
+    __slots__ = ("queue", "workers", "pending_leases", "lease_req_ts")
 
     def __init__(self):
         self.queue: deque = deque()
         self.workers: List[_LeasedWorker] = []
         self.pending_leases = 0
+        self.lease_req_ts = 0.0  # when leases were last requested
 
 
 class _ActorState:
@@ -133,11 +134,6 @@ class CoreContext:
         # executor / misc state (must exist before any thread starts)
         self.assigned_tpu_ids: List[int] = []
         self._exec_queue: "queue_mod.Queue" = queue_mod.Queue()
-        # coalesced task replies (see run_executor / _flush_pending_replies)
-        self._pending_replies: Dict[P.Connection, list] = {}
-        self._n_pending_replies = 0
-        self._reply_first_ts: Optional[float] = None
-        self._reply_lock = threading.Lock()
         self._actor_instance = None
         self._actor_spec: Optional[TaskSpec] = None
         self._cancelled: set = set()
@@ -211,9 +207,6 @@ class CoreContext:
             self._cancelled.add(TaskID(msg[2]))
         elif mt == P.TASK_REPLY:
             self._handle_task_reply(conn, *msg[2:])
-        elif mt == P.TASK_REPLY_BATCH:
-            for r in msg[2]:
-                self._handle_task_reply(conn, *r)
 
     def _on_head_message(self, conn: P.Connection, msg):
         mt = msg[0]
@@ -298,7 +291,6 @@ class CoreContext:
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None
             ) -> List[Any]:
-        self._flush_pending_replies()
         oids = [r.id for r in refs]
         self._ensure_resolution(refs)
         ready = self.memory_store.wait_ready(oids, len(oids), timeout)
@@ -311,7 +303,6 @@ class CoreContext:
     def wait(self, refs: Sequence[ObjectRef], num_returns: int,
              timeout: Optional[float], fetch_local: bool = True
              ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
-        self._flush_pending_replies()
         self._ensure_resolution(refs)
         ready_ids = set(self.memory_store.wait_ready(
             [r.id for r in refs], num_returns, timeout))
@@ -614,6 +605,8 @@ class CoreContext:
                     - len(st.workers) - st.pending_leases,
                     cfg.max_pending_lease_requests_per_class
                     - st.pending_leases)
+                if wanted > 0:
+                    st.lease_req_ts = time.monotonic()
                 for _ in range(max(0, wanted)):
                     st.pending_leases += 1
                     threading.Thread(
@@ -641,10 +634,15 @@ class CoreContext:
                             worker = w
                 if worker is None:
                     break
-                # Even share across current free workers AND leases still
-                # pending: don't stuff one pipeline with work a soon-to-
-                # arrive worker could run in parallel.
-                targets = n_free + st.pending_leases
+                # Even share across free workers plus leases that are
+                # FRESH (requested < 1s ago): hold work back for workers
+                # about to arrive, but a pending lease can be ungrantable
+                # forever on a saturated node — once stale, stop counting
+                # it, or the share shrinks to ~1 and a small burst
+                # serializes into one round-trip per task.
+                fresh = (st.pending_leases
+                         if time.monotonic() - st.lease_req_ts < 1.0 else 0)
+                targets = n_free + fresh
                 share = max(1, (demand + targets - 1) // targets)
                 slots = min(cap, share) - len(worker.inflight)
                 if slots <= 0:
@@ -1125,7 +1123,6 @@ class CoreContext:
             try:
                 item = self._exec_queue.get(timeout=1.0)
             except queue_mod.Empty:
-                self._flush_pending_replies()
                 continue
             if item is None:
                 break
@@ -1134,7 +1131,6 @@ class CoreContext:
             if (aspec is not None and aspec.max_concurrency > 1
                     and spec.task_type == TaskType.ACTOR_TASK
                     and spec.method_name != "__ray_terminate__"):
-                self._flush_pending_replies()
                 if pool is None:
                     import concurrent.futures as cf
 
@@ -1151,52 +1147,15 @@ class CoreContext:
                     # where terminate queues behind pending tasks).
                     pool.shutdown(wait=True)
                     pool = None
-                # Age-bound batching: a reply is withheld only while MORE
-                # work is queued AND for at most ~1ms — so back-to-back
-                # microsecond tasks coalesce into one frame, but a long
-                # task never holds an earlier task's finished result
-                # hostage (the caller may need it to unblock that very
-                # task).
-                if self._reply_age_exceeded(0.001):
-                    self._flush_pending_replies()
-                reply = self._execute_guarded(spec, conn)
-                if reply is not None:
-                    with self._reply_lock:
-                        self._pending_replies.setdefault(
-                            conn, []).append(reply)
-                        self._n_pending_replies += 1
-                        if self._reply_first_ts is None:
-                            self._reply_first_ts = time.monotonic()
-                if self._n_pending_replies >= 64 or \
-                        self._exec_queue.qsize() == 0:
-                    self._flush_pending_replies()
-
-    def _reply_age_exceeded(self, age_s: float) -> bool:
-        ts = self._reply_first_ts
-        return ts is not None and time.monotonic() - ts > age_s
-
-    def _flush_pending_replies(self):
-        """Send all coalesced task replies. Also called from get()/wait()
-        (a task nested-blocking on its own driver must not strand earlier
-        results) and from _graceful_exit (replies must beat os._exit)."""
-        with self._reply_lock:
-            if not self._n_pending_replies:
-                return
-            pending = self._pending_replies
-            self._pending_replies = {}
-            self._n_pending_replies = 0
-            self._reply_first_ts = None
-        for conn, replies in pending.items():
-            try:
-                if len(replies) == 1:
-                    conn.send(P.TASK_REPLY, *replies[0])
-                else:
-                    conn.send(P.TASK_REPLY_BATCH, replies)
-            except P.ConnectionLost:
-                pass
+                self._execute_safe(spec, conn)
 
     def _execute_safe(self, spec: TaskSpec, conn: P.Connection):
-        """Pool-path execution: send the reply immediately."""
+        """Execute and reply immediately. Replies are NOT coalesced: the
+        worker's send syscalls run in a separate process from the driver
+        (no GIL contention), and an immediate reply lets the submitter
+        refill this worker's pipeline sooner — measured faster than reply
+        batching, and a long-running next task can never withhold an
+        earlier task's finished result."""
         reply = self._execute_guarded(spec, conn)
         if reply is not None:
             try:
@@ -1338,9 +1297,6 @@ class CoreContext:
 
     def _graceful_exit(self):
         self._shutdown = True
-        # Completed-but-coalesced replies must reach their callers before
-        # os._exit, or a succeeded task reads as ActorDiedError.
-        self._flush_pending_replies()
         try:
             self.head.send(P.WORKER_EXIT)
         except P.ConnectionLost:

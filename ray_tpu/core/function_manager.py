@@ -30,7 +30,14 @@ class FunctionManager:
         self._lock = threading.Lock()
 
     def export(self, obj: Any) -> str:
-        """Serialize a function/class, export to KV, return its id."""
+        """Serialize a function/class, export to KV, return its id.
+
+        Memoized by object identity: a remote function's code and captured
+        globals are snapshotted at FIRST submission, and later mutations of
+        captured globals are not re-exported (matches the reference —
+        python/ray/remote_function.py pickles once per function object, so
+        mutating a module global between calls was never propagated there
+        either). Redefine the function to pick up new state."""
         try:
             fn_id = self._id_by_obj.get(obj)
         except TypeError:  # unhashable/unweakrefable callable

@@ -28,20 +28,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from . import protocol as P
-
-
-def _local_ip() -> str:
-    """Best-effort primary IP (falls back to loopback in sandboxes)."""
-    import socket as _socket
-
-    try:
-        s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
-        s.connect(("8.8.8.8", 80))
-        ip = s.getsockname()[0]
-        s.close()
-        return ip
-    except OSError:
-        return "127.0.0.1"
+from .protocol import local_ip as _local_ip
 from .config import get_config
 from .ids import ActorID, ObjectID, PlacementGroupID
 from .object_store import ShmObjectStore
@@ -106,6 +93,8 @@ class NodeState:
     agent_conn: Optional[P.Connection] = None
     node_ip: str = ""
     session_dir: str = ""
+    # the host's peer-to-peer object TransferServer (object_transfer.py)
+    transfer_addr: str = ""
 
     @property
     def is_remote(self) -> bool:
@@ -148,9 +137,23 @@ class Head:
         self._next_node_idx = 0
         self._driver_conn: Optional[P.Connection] = None
         self._shutdown = False
+        # P2P object plane for the head's in-process nodes (lazy, multi-host
+        # only): serves local arenas to remote agents and pulls from them.
+        self._transfer_server = None
+        self._pullers: Dict[int, object] = {}  # local node idx -> ObjectPuller
+        # bytes relayed through head memory on the legacy path — the P2P
+        # tests assert this stays 0 for host<->host transfers
+        self.relay_bytes = 0
 
     def start(self):
         self.io.start()
+        # Housekeeping loop: pending-PG retries and idle-worker reaping
+        # must not depend on any client calling in — a placement group
+        # that couldn't be placed at creation (resources transiently held
+        # by leases) would otherwise stay pending forever.
+        self._housekeeper = threading.Thread(
+            target=self._housekeeping_loop, daemon=True, name="head-keeper")
+        self._housekeeper.start()
         # Prestart the worker pool (reference: WorkerPool prestart,
         # worker_pool.cc num_prestarted_python_workers): interpreter
         # startup costs O(seconds); forking CPU-count workers now means a
@@ -179,7 +182,33 @@ class Head:
                               _local_ip())
         self.tcp_addr = f"tcp:{ip}:{bound_port}"
         self.io.add_listener(self._tcp_listener, self._on_accept)
+        # Multi-host session: serve the head's local arenas to peers.
+        from .object_transfer import TransferServer
+
+        self._transfer_server = TransferServer(
+            self.io, self._read_local_object, advertise_ip=ip)
         return self.tcp_addr
+
+    def _read_local_object(self, oid: ObjectID):
+        """TransferServer read_fn over every in-process node store."""
+        with self._lock:
+            loc = self.objects.get(oid)
+            node = self.nodes.get(loc.node_idx) if loc else None
+        if node is None or node.store is None:
+            return None
+        got = node.store.get(oid)
+        if got is None:
+            return None
+        data_v, meta_v = got
+        return data_v, bytes(meta_v), lambda: node.store.release(oid)
+
+    def _puller_for(self, node: NodeState):
+        from .object_transfer import ObjectPuller
+
+        p = self._pullers.get(node.idx)
+        if p is None:
+            p = self._pullers[node.idx] = ObjectPuller(self.io, node.store)
+        return p
 
     # ------------------------------------------------------------- nodes
 
@@ -208,7 +237,8 @@ class Head:
 
     def register_remote_node(self, conn: P.Connection, resources,
                              store_name: str, node_ip: str,
-                             session_dir: str) -> int:
+                             session_dir: str,
+                             transfer_addr: str = "") -> int:
         """A node agent on another host joins over TCP (the reference's
         raylet registration with the GCS, gcs_node_manager.cc)."""
         with self._lock:
@@ -216,7 +246,8 @@ class Head:
             self._next_node_idx += 1
             node = NodeState(idx=idx, resources=resources, store=None,
                              store_name=store_name, agent_conn=conn,
-                             node_ip=node_ip, session_dir=session_dir)
+                             node_ip=node_ip, session_dir=session_dir,
+                             transfer_addr=transfer_addr)
             self.nodes[idx] = node
             self.scheduler.add_node(idx, resources)
         conn.peer = f"agent:node{idx}"
@@ -230,9 +261,9 @@ class Head:
             self.remove_node(idx, kill_workers=True)
 
     def _h_register_node(self, conn, rid, resources, store_name, node_ip,
-                         session_dir):
+                         session_dir, transfer_addr=""):
         idx = self.register_remote_node(conn, resources, store_name,
-                                        node_ip, session_dir)
+                                        node_ip, session_dir, transfer_addr)
         conn.reply(rid, idx, self.session_name,
                    msg_type=P.REGISTER_NODE_REPLY)
         self._try_fulfill_pending()
@@ -585,6 +616,8 @@ class Head:
                     node.idle_by_class.setdefault(w.sched_class, []).append(
                         worker_id)
         self._try_fulfill_pending()
+        # freed resources may unblock a pending placement group too
+        self._retry_pending_pgs()
 
     def _handle_worker_death(self, w: WorkerInfo):
         with self._lock:
@@ -1047,6 +1080,8 @@ class Head:
                 node.store.release(oid)
         payload, meta = node.agent_conn.call(
             P.AGENT_OBJ_GET, oid.binary(), timeout=120)
+        if payload is not None:
+            self.relay_bytes += len(payload)
         return None if payload is None else (payload, meta)
 
     def _node_store_write(self, node: NodeState, oid: ObjectID,
@@ -1064,8 +1099,29 @@ class Head:
             buf[len(payload):] = meta
             node.store.seal(oid)
         else:
+            self.relay_bytes += len(payload)
             node.agent_conn.call(P.AGENT_OBJ_PUT, oid.binary(), payload,
                                  meta, timeout=120)
+
+    def _p2p_transfer(self, oid: ObjectID, src_node: NodeState,
+                      dst_node: NodeState) -> bool:
+        """Direct host-to-host pull; returns False to fall back to relay."""
+        src_addr = (src_node.transfer_addr if src_node.is_remote
+                    else (self._transfer_server.addr
+                          if self._transfer_server else ""))
+        if not src_addr:
+            return False
+        try:
+            if dst_node.is_remote:
+                # dst agent pulls straight from the src host
+                reply = dst_node.agent_conn.call(
+                    P.PULL_OBJECT, oid.binary(), src_addr, timeout=120)
+                return bool(reply[0])
+            # dst is a head-local node: the head IS the destination host —
+            # pull from the src agent directly into the local arena.
+            return bool(self._puller_for(dst_node).pull(oid, src_addr))
+        except (P.ConnectionLost, TimeoutError):
+            return False
 
     def _h_object_transfer(self, conn, rid, oid_bin, to_node_idx):
         """Copy an object from its node's arena (or spill file) into
@@ -1097,6 +1153,16 @@ class Head:
             if self._node_store_contains(dst_node, oid):
                 conn.reply(rid, True)
                 return
+            src_node = self.nodes.get(loc.node_idx)
+            if not loc.spilled_path and src_node is not None and \
+                    (src_node.is_remote or dst_node.is_remote):
+                # Peer-to-peer path: the head only brokers the pull — the
+                # payload rides a direct host<->host connection (reference:
+                # ObjectManager chunked pull, never through the GCS).
+                if self._p2p_transfer(oid, src_node, dst_node):
+                    conn.reply(rid, True)
+                    return
+                # fall through to the relay path on any P2P failure
             if loc.spilled_path:
                 with open(loc.spilled_path, "rb") as f:
                     data = f.read()
@@ -1241,8 +1307,20 @@ class Head:
 
     # ------------------------------------------------------------ lifecycle
 
+    def _housekeeping_loop(self):
+        while not self._shutdown:
+            time.sleep(0.25)
+            try:
+                self.periodic()
+            except Exception:
+                if not self._shutdown:
+                    import traceback
+
+                    traceback.print_exc()
+
     def periodic(self):
-        """Housekeeping: PG retries, idle worker reaping. Called by driver."""
+        """Housekeeping: PG retries, lease grants, idle worker reaping.
+        Driven by the head's own keeper thread (and callable from tests)."""
         self._retry_pending_pgs()
         self._try_fulfill_pending()
         cfg = get_config()

@@ -63,14 +63,30 @@ class NodeAgent:
                                    object_store_memory=cap,
                                    resources=resources, labels=labels)
         self.io = P.IOLoop("agent-io")
+        # Direct peer-to-peer object plane (object_transfer.py): this host
+        # serves its arena to peers and pulls from theirs — payloads never
+        # transit the head.
+        from .object_transfer import ObjectPuller, TransferServer
+
+        self.transfer_server = TransferServer(
+            self.io, self._read_object, advertise_ip=self.node_ip)
+        self.puller = ObjectPuller(self.io, self.store)
         sock = P.connect_addr(head_addr)
         self.head = P.Connection(sock, peer="head")
         self.head.on_close = lambda c: self._shutdown.set()
         self.io.add_connection(self.head, self._on_head_message)
         self.io.start()
         reply = self.head.call(P.REGISTER_NODE, nr, self.store_name,
-                               self.node_ip, self.session_dir, timeout=30)
+                               self.node_ip, self.session_dir,
+                               self.transfer_server.addr, timeout=30)
         self.node_idx, self.session_name = reply[0], reply[1]
+
+    def _read_object(self, oid: ObjectID):
+        got = self.store.get(oid)
+        if got is None:
+            return None
+        data_v, meta_v = got
+        return data_v, bytes(meta_v), lambda: self.store.release(oid)
 
     # -------------------------------------------------------- head messages
 
@@ -102,6 +118,12 @@ class NodeAgent:
                     buf[len(payload):] = meta
                     self.store.seal(oid)
                 conn.reply(rid, True)
+            elif mt == P.PULL_OBJECT:
+                # head says: fetch this object straight from a peer host
+                oid, peer = ObjectID(msg[2]), msg[3]
+                threading.Thread(
+                    target=self._do_pull, args=(conn, rid, oid, peer),
+                    daemon=True).start()
             elif mt == P.AGENT_OBJ_FREE:
                 for ob in msg[2]:
                     self.store.delete(ObjectID(ob))
@@ -110,6 +132,18 @@ class NodeAgent:
         except Exception as e:  # noqa: BLE001
             if rid > 0:
                 conn.reply_error(rid, e)
+
+    def _do_pull(self, conn: P.Connection, rid: int, oid: ObjectID,
+                 peer: str):
+        try:
+            ok = self.puller.pull(oid, peer)
+            conn.reply(rid, ok)
+        except Exception as e:  # noqa: BLE001
+            if rid > 0:
+                try:
+                    conn.reply_error(rid, e)
+                except P.ConnectionLost:
+                    pass
 
     # ------------------------------------------------------------- workers
 
@@ -178,6 +212,11 @@ class NodeAgent:
                     pass
         try:
             self.head.close()
+        except Exception:
+            pass
+        try:
+            self.transfer_server.close()
+            self.puller.close()
         except Exception:
             pass
         self.io.stop()

@@ -1,0 +1,260 @@
+"""Peer-to-peer chunked object transfer between hosts.
+
+Ref analog: src/ray/object_manager/ — the reference's per-node
+ObjectManager serves 5 MiB chunk pulls directly between raylets
+(object_manager.proto, pull_manager.cc) so object payloads never transit
+the GCS. Same shape here: every host (each node agent, plus the head on
+behalf of its in-process nodes) runs a ``TransferServer`` — a dedicated
+TCP listener streaming objects out of the local shm arena in ~1 MiB raw
+frames — and an ``ObjectPuller`` that connects straight to a peer's
+server and writes arriving chunks into the local arena. The head only
+brokers *who pulls from whom* (it hands the destination the source's
+transfer address); payload bytes never touch head memory (asserted by
+tests via the head's relay-byte counter).
+
+Wire flow (all frames on a direct peer<->peer connection):
+    puller -> server   OBJ_PULL (oid)                       one-way
+    server -> puller   OBJ_PULL_META (oid, size|-1, meta)   create buffer
+    server -> puller   OBJ_PULL_CHUNK hdr + RAW frame  x N  (atomic pair)
+    server -> puller   OBJ_PULL_DONE (oid)                  seal + wake
+
+Every buffer mutation happens on the puller's single IO thread, in stream
+order — META creates the arena buffer before any chunk of that object can
+be dispatched, so there is no allocation/arrival race by construction.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from . import protocol as P
+from .config import get_config
+from .ids import ObjectID
+from .object_store import ObjectExistsError, ShmObjectStore
+
+
+class TransferServer:
+    """Serves OBJ_PULL requests for objects in local shm arenas.
+
+    ``read_fn(oid) -> (data_memoryview, meta_bytes, release_cb) | None``
+    abstracts over "one agent store" vs "the head's local node stores".
+    """
+
+    def __init__(self, io: P.IOLoop, read_fn: Callable, host: str = "",
+                 advertise_ip: str = ""):
+        self._read_fn = read_fn
+        self._listener = P.listen_tcp(host or "0.0.0.0", 0)
+        port = self._listener.getsockname()[1]
+        ip = advertise_ip or P.local_ip()
+        self.addr = f"tcp:{ip}:{port}"
+        self._io = io
+        io.add_listener(self._listener, self._on_accept)
+
+    def _on_accept(self, sock, _addr):
+        sock.setsockopt(P.socket.IPPROTO_TCP, P.socket.TCP_NODELAY, 1)
+        conn = P.Connection(sock, peer="xfer-client")
+        self._io.add_connection(conn, self._on_message)
+
+    def _on_message(self, conn: P.Connection, msg):
+        if msg[0] != P.OBJ_PULL:
+            return
+        # Stream on a side thread: a multi-GiB send must not wedge the IO
+        # loop that every other connection on this host shares. Concurrent
+        # pulls on one connection are safe: each chunk's header+raw pair is
+        # sent atomically (send_with_raw), and the puller writes by the
+        # (oid, offset) in each header.
+        threading.Thread(target=self._serve_pull, args=(conn, msg[2]),
+                         daemon=True).start()
+
+    def _serve_pull(self, conn: P.Connection, oid_bin: bytes):
+        oid = ObjectID(oid_bin)
+        got = self._read_fn(oid)
+        try:
+            if got is None:
+                conn.send(P.OBJ_PULL_META, oid_bin, -1, b"")
+                return
+            data, meta, release = got
+            try:
+                conn.send(P.OBJ_PULL_META, oid_bin, len(data), bytes(meta))
+                # ~1 MiB chunks so each typically completes within one
+                # receiver recv() buffer, hitting feed()'s zero-copy fast
+                # path (protocol.py). Each chunk is written straight from
+                # the shm arena view — no serialization copies.
+                cs = min(get_config().object_transfer_chunk_bytes, 1 << 20)
+                for off in range(0, len(data), cs):
+                    end = min(off + cs, len(data))
+                    conn.send_with_raw(P.OBJ_PULL_CHUNK, oid_bin, off,
+                                       raw=data[off:end])
+                conn.send(P.OBJ_PULL_DONE, oid_bin)
+            finally:
+                release()
+        except P.ConnectionLost:
+            pass
+
+    def close(self):
+        try:
+            self._io.remove(self._listener)
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class _PullState:
+    __slots__ = ("buf", "done", "error", "conn")
+
+    def __init__(self, conn: P.Connection):
+        self.buf = None
+        self.done = threading.Event()
+        self.error: Optional[str] = None
+        self.conn = conn
+
+
+class ObjectPuller:
+    """Pulls objects from peers' TransferServers into a local shm store."""
+
+    def __init__(self, io: P.IOLoop, store: ShmObjectStore):
+        self._io = io
+        self._store = store
+        self._conns: Dict[str, P.Connection] = {}
+        self._pending: Dict[ObjectID, _PullState] = {}
+        # per-connection (oid, offset) the next RAW frame belongs to —
+        # send_with_raw guarantees the raw frame directly follows its header
+        self._expect: Dict[P.Connection, Tuple[ObjectID, int]] = {}
+        self._lock = threading.Lock()
+
+    def _peer(self, addr: str) -> P.Connection:
+        with self._lock:
+            conn = self._conns.get(addr)
+            if conn is not None and not conn.closed:
+                return conn
+        sock = P.connect_addr(addr)
+        conn = P.Connection(sock, peer=f"xfer:{addr}")
+        conn.on_close = self._on_conn_close
+        self._io.add_connection(conn, self._on_message)
+        with self._lock:
+            self._conns[addr] = conn
+        return conn
+
+    def pull(self, oid: ObjectID, peer_addr: str,
+             timeout: float = 120.0) -> bool:
+        """Blocking: fetch `oid` from the peer into the local store."""
+        if self._store.contains(oid):
+            return True
+        try:
+            conn = self._peer(peer_addr)
+        except OSError:
+            return False
+        with self._lock:
+            st = self._pending.get(oid)
+            if st is not None:
+                leader = False
+            else:
+                st = self._pending[oid] = _PullState(conn)
+                leader = True
+        if not leader:  # another thread is already pulling this object
+            st.done.wait(timeout)
+            return st.error is None and self._store.contains(oid)
+        try:
+            st.conn.send(P.OBJ_PULL, oid.binary())
+            if not st.done.wait(timeout):
+                st.error = "pull timed out"
+        except P.ConnectionLost as e:
+            st.error = str(e)
+        finally:
+            with self._lock:
+                self._pending.pop(oid, None)
+            if st.error is not None and not self._store.contains(oid):
+                # never leave a created-but-unsealed entry behind: it would
+                # poison every retry (create fails on existing ids) while
+                # readers block forever on an object that never seals
+                st.buf = None
+                self._store.delete(oid)
+            st.done.set()
+        return st.error is None
+
+    # ---- everything below runs on the IO thread, in stream order ----
+
+    def _on_message(self, conn: P.Connection, msg):
+        mt = msg[0]
+        if mt == P.OBJ_PULL_META:
+            oid, size, meta = ObjectID(msg[2]), msg[3], msg[4]
+            with self._lock:
+                st = self._pending.get(oid)
+            if st is None:
+                return
+            if size < 0:
+                st.error = "object not on peer"
+                st.done.set()
+                return
+            try:
+                st.buf = self._store.create(oid, size, len(meta))
+            except ObjectExistsError:
+                if self._store.contains(oid):  # already sealed locally
+                    st.done.set()
+                    return
+                # unsealed leftover from a failed earlier pull: reclaim
+                self._store.delete(oid)
+                try:
+                    st.buf = self._store.create(oid, size, len(meta))
+                except Exception as e:  # noqa: BLE001
+                    st.error = f"create failed: {e}"
+                    st.done.set()
+                    return
+            except Exception as e:  # noqa: BLE001 — e.g. store full
+                st.error = f"create failed: {e}"
+                st.done.set()
+                return
+            st.buf[size:] = meta
+            if size == 0:
+                st.buf = None
+                self._store.seal(oid)
+                st.done.set()
+        elif mt == P.OBJ_PULL_CHUNK:
+            self._expect[conn] = (ObjectID(msg[2]), msg[3])
+        elif mt == P.RAW_FRAME:
+            exp = self._expect.pop(conn, None)
+            if exp is None:
+                return
+            oid, off = exp
+            payload = msg[2]
+            with self._lock:
+                st = self._pending.get(oid)
+            buf = st.buf if st is not None else None
+            if buf is not None:
+                import numpy as np
+
+                # vectorized copy into the arena (~2x a memoryview slice
+                # assignment; this is the receive-side hot loop). payload
+                # may be a memoryview into the recv buffer (feed()'s
+                # zero-copy fast path) — consumed before returning.
+                np.copyto(
+                    np.frombuffer(buf[off:off + len(payload)], np.uint8),
+                    np.frombuffer(payload, np.uint8))
+        elif mt == P.OBJ_PULL_DONE:
+            oid = ObjectID(msg[2])
+            with self._lock:
+                st = self._pending.get(oid)
+            if st is not None and st.buf is not None:
+                st.buf = None  # drop the arena view before sealing
+                try:
+                    self._store.seal(oid)
+                except KeyError:
+                    st.error = "seal failed"
+                st.done.set()
+
+    def _on_conn_close(self, conn: P.Connection):
+        """Peer died mid-pull: fail its pending pulls now, not at timeout."""
+        with self._lock:
+            stale = [st for st in self._pending.values() if st.conn is conn]
+        for st in stale:
+            st.error = "transfer connection lost"
+            st.done.set()
+
+    def close(self):
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            c.on_close = None
+            c.close()

@@ -80,7 +80,22 @@ TASK_REPLY = 51         # (task_id_bin, status, result_meta, err)  [rpc reply]
 STEAL_BACK = 52
 PUSH_CANCEL = 53        # (task_id_bin, force)
 PUSH_TASK_BATCH = 54    # ([task_specs],) one frame, one pickle, one syscall
-TASK_REPLY_BATCH = 55   # ([(task_id_bin, status, result_meta, err), ...])
+
+# peer-to-peer object transfer (object_transfer.py; the reference's
+# ObjectManagerService chunked pull, object_manager.proto:61)
+PULL_OBJECT = 56        # head->agent: (oid_bin, peer_transfer_addr) -> ok
+OBJ_PULL = 57           # puller->server, one-way: (oid_bin)
+OBJ_PULL_CHUNK = 58     # server->puller header: (oid_bin, offset, size);
+#                         the chunk bytes follow as ONE raw frame
+OBJ_PULL_DONE = 59      # server->puller: (oid_bin)
+RAW_FRAME = 60          # synthetic msg type for raw frames: (RAW_FRAME, 0, bytes)
+OBJ_PULL_META = 61      # server->puller: (oid_bin, size|-1, meta_bytes)
+
+# High bit of the length prefix marks a RAW frame: the payload is
+# unpickled bytes (bulk data follows its pickled header message). Sending
+# side writes straight from a memoryview (e.g. an shm arena slice) with
+# zero serialization copies.
+_RAW_BIT = 0x8000_0000
 
 
 class ConnectionLost(Exception):
@@ -112,6 +127,12 @@ class Connection:
 
     def send(self, msg_type: int, *fields, request_id: int = 0):
         payload = pickle.dumps((msg_type, request_id, *fields), protocol=5)
+        if len(payload) >= _RAW_BIT:
+            # the high length bit marks RAW frames — a >=2 GiB pickled
+            # frame would be misparsed by the receiver; move such data in
+            # chunks (e.g. via the transfer plane) instead
+            raise ValueError(
+                f"frame too large ({len(payload)} bytes); chunk it")
         data = _LEN.pack(len(payload)) + payload
         with self._wlock:
             if self.closed:
@@ -151,13 +172,39 @@ class Connection:
                     except OSError:
                         pass
                     raise OSError("send stalled: peer not draining")
-                _select.select([], [self.sock], [], 1.0)
+                try:
+                    _select.select([], [self.sock], [], 1.0)
+                except (OSError, ValueError) as e:
+                    # Connection closed concurrently (fd now -1/invalid):
+                    # surface as a normal send failure, not a ValueError
+                    # that would escape callers' ConnectionLost handling.
+                    raise OSError(f"connection closed during send: {e}")
                 continue
             except InterruptedError:
                 continue
             if n:
                 deadline = time.monotonic() + stall_timeout
             mv = mv[n:]
+
+    def send_with_raw(self, msg_type: int, *fields, raw) -> None:
+        """Send a pickled header message immediately followed by a RAW
+        frame (bytes/memoryview, no pickling) — atomic with respect to
+        other senders on this connection, so concurrent streams can never
+        interleave between a header and its raw payload. The receiver sees
+        the raw frame as ``(RAW_FRAME, 0, bytes)`` right after the header."""
+        n = len(raw)
+        if n >= _RAW_BIT:
+            raise ValueError("raw frame too large")
+        header = pickle.dumps((msg_type, 0, *fields), protocol=5)
+        with self._wlock:
+            if self.closed:
+                raise ConnectionLost(self.peer)
+            try:
+                self._send_all(_LEN.pack(len(header)) + header)
+                self._send_all(_LEN.pack(n | _RAW_BIT))
+                self._send_all(raw)
+            except OSError as e:
+                raise ConnectionLost(f"{self.peer}: {e}") from e
 
     def call(self, msg_type: int, *fields, timeout: Optional[float] = None):
         """Send a request and block for its reply; returns reply fields."""
@@ -185,18 +232,45 @@ class Connection:
     # -- receive side --
 
     def feed(self, data: bytes):
-        """Feed raw bytes; yields complete messages."""
-        self._rbuf += data
+        """Feed raw bytes; yields complete messages.
+
+        Fast path: when no partial frame is buffered, frames are parsed
+        straight out of ``data`` with zero copies — RAW frame payloads are
+        then memoryviews into ``data`` and are only valid until the caller
+        finishes iterating the returned list (the transfer plane consumes
+        them synchronously).
+        """
         msgs = []
+        if not self._rbuf:
+            src = memoryview(data)
+            pos, n = 0, len(src)
+            while n - pos >= 4:
+                (ln,) = _LEN.unpack_from(src, pos)
+                raw = bool(ln & _RAW_BIT)
+                ln &= ~_RAW_BIT
+                if n - pos - 4 < ln:
+                    break
+                payload = src[pos + 4:pos + 4 + ln]
+                msgs.append((RAW_FRAME, 0, payload) if raw
+                            else pickle.loads(payload))
+                pos += 4 + ln
+            if pos < n:
+                self._rbuf += src[pos:]
+            return msgs
+        # slow path: a partial frame spans recv() calls — buffer and copy
+        self._rbuf += data
         while True:
             if len(self._rbuf) < 4:
                 break
             (ln,) = _LEN.unpack_from(self._rbuf)
+            raw = bool(ln & _RAW_BIT)
+            ln &= ~_RAW_BIT
             if len(self._rbuf) < 4 + ln:
                 break
             payload = bytes(self._rbuf[4:4 + ln])
             del self._rbuf[:4 + ln]
-            msgs.append(pickle.loads(payload))
+            msgs.append((RAW_FRAME, 0, payload) if raw
+                        else pickle.loads(payload))
         return msgs
 
     def dispatch_reply(self, msg) -> bool:
@@ -326,7 +400,7 @@ class IOLoop:
 
     def _service_conn(self, sock, on_message, conn: Connection):
         try:
-            data = sock.recv(1 << 20)
+            data = sock.recv(1 << 22)
         except BlockingIOError:
             return
         except OSError:
@@ -376,6 +450,18 @@ def listen_tcp(host: str = "0.0.0.0", port: int = 0) -> socket.socket:
     s.bind((host, port))
     s.listen(128)
     return s
+
+
+def local_ip() -> str:
+    """Best-effort outward-facing IP (no packets sent; UDP connect only)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
 
 
 def connect_addr(addr: str, timeout: float = 10.0) -> socket.socket:

@@ -37,6 +37,13 @@ class TransformerConfig:
     # Microbatches for pipeline parallelism (mesh pipeline axis > 1);
     # None -> 2 * n_stages. Bubble fraction is (S-1)/(M+S-1).
     pipeline_microbatches: Optional[int] = None
+    # Mixture-of-Experts FFN (models/moe.py): 0 = dense. Experts shard over
+    # the `expert` mesh axis; top-k routing with renormalized combine
+    # weights; capacity C = ceil(T*k/E * capacity_factor).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def kv_heads(self) -> int:

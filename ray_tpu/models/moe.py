@@ -1,0 +1,116 @@
+"""Mixture-of-Experts FFN with expert parallelism over the ``expert`` axis.
+
+Absent from the reference (SURVEY.md §2.3 marks EP as greenfield-mandatory).
+TPU-first design: GShard/Switch-style *dense* dispatch — routing becomes two
+einsums against a one-hot capacity tensor, so the whole layer is static-shaped
+matmuls the MXU likes, and sharding the expert-major tensors over the
+``expert`` mesh axis makes XLA insert the canonical all-to-all pair around
+the expert FFN (no ragged ops, no host loops).
+
+Routing: top-k (default 2) with combine weights renormalized to sum to 1
+(Mixtral-style). With all experts initialized identically the layer is then
+numerically EQUAL to the dense FFN it replaces — the parity tests exploit
+this. Tokens overflowing an expert's capacity C = ceil(T*k/E * factor) are
+dropped (contribute zero), the standard Switch behavior.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ray_tpu.parallel.sharding import with_logical_constraint as _wlc
+
+Params = Dict[str, Any]
+
+
+def moe_param_logical_axes() -> Dict[str, tuple]:
+    """Logical axes for one layer-stack of MoE parameters (leading layers
+    axis; experts axis sharded over the ``expert`` mesh axis)."""
+    return {
+        "router": ("layers", "embed", "experts"),
+        "w_gate": ("layers", "experts", "embed", "mlp"),
+        "w_up": ("layers", "experts", "embed", "mlp"),
+        "w_down": ("layers", "experts", "mlp", "embed"),
+    }
+
+
+def init_moe_params(rng: jax.Array, cfg) -> Params:
+    """Stacked per-layer MoE params: router [L,d,E] + expert FFNs [L,E,...]."""
+    L, d, ff, E = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.moe_experts
+    pd = cfg.param_dtype
+    k = iter(jax.random.split(rng, 8))
+
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(pd)
+
+    in_scale = d ** -0.5
+    out_scale = (2 * L) ** -0.5 * d ** -0.5 * (ff / d) ** 0.5
+    return {
+        "router": normal(next(k), (L, d, E), in_scale),
+        "w_gate": normal(next(k), (L, E, d, ff), in_scale),
+        "w_up": normal(next(k), (L, E, d, ff), in_scale),
+        "w_down": normal(next(k), (L, E, ff, d), out_scale),
+    }
+
+
+def moe_ffn(h: jax.Array, lp: Params, cfg, mesh: Optional[Mesh] = None
+            ) -> Tuple[jax.Array, jax.Array]:
+    """One MoE FFN layer. h: [B, T, d] -> (out [B, T, d], aux_loss scalar).
+
+    lp: per-layer params {router [d,E], w_gate/w_up [E,d,f], w_down [E,f,d]}.
+    aux_loss is the Switch load-balance term E * sum_e f_e * p_e (1.0 when
+    perfectly balanced); weight it into the train loss via
+    cfg.moe_aux_weight.
+    """
+    B, T, d = h.shape
+    E, k = cfg.moe_experts, cfg.moe_top_k
+    C = max(1, math.ceil(T * k / E * cfg.moe_capacity_factor))
+    dtype = h.dtype
+
+    logits = jnp.einsum("btd,de->bte", h.astype(jnp.float32),
+                        lp["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)  # [B,T,E] float32
+
+    top_p, top_i = jax.lax.top_k(probs, k)  # [B,T,k]
+    top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+
+    # Flatten the k routing slots into a priority-ordered stream per batch
+    # row; earlier tokens (and within a token, higher-probability slots)
+    # claim capacity first.
+    oh = jax.nn.one_hot(top_i, E, dtype=jnp.float32)     # [B,T,k,E]
+    oh = oh.reshape(B, T * k, E)                          # [B,S,E]
+    pos = jnp.cumsum(oh, axis=1) - 1.0                    # slot within expert
+    in_cap = (pos < C) * oh                               # [B,S,E]
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                          dtype=jnp.float32) * in_cap[..., None]  # [B,S,E,C]
+
+    # dispatch: [B,S,E,C] x [B,S,d] -> [E,B,C,d] (all-to-all over `expert`)
+    hk = jnp.broadcast_to(h[:, :, None, :], (B, T, k, d)).reshape(B, T * k, d)
+    xin = jnp.einsum("bsec,bsd->ebcd", slot.astype(dtype), hk)
+    xin = _wlc(xin, ("experts", "batch", None, "embed"), mesh=mesh)
+
+    # expert FFN (SwiGLU), expert-major so E shards over the expert axis
+    gate = jnp.einsum("ebcd,edf->ebcf", xin, lp["w_gate"].astype(dtype))
+    up = jnp.einsum("ebcd,edf->ebcf", xin, lp["w_up"].astype(dtype))
+    act = jax.nn.silu(gate) * up
+    act = _wlc(act, ("experts", "batch", None, "mlp"), mesh=mesh)
+    out = jnp.einsum("ebcf,efd->ebcd", act, lp["w_down"].astype(dtype))
+
+    # combine: weight each claimed slot by its (renormalized) router prob
+    combine = slot * top_p.reshape(B, T * k, 1, 1).astype(jnp.float32)
+    y = jnp.einsum("ebcd,bsec->bsd", out.astype(jnp.float32), combine)
+    y = y.reshape(B, T, k, d).sum(axis=2).astype(dtype)
+    y = _wlc(y, ("batch", "seq", "embed"), mesh=mesh)
+
+    # Switch aux loss: fraction of tokens dispatched to e (top-1 slot) times
+    # mean router prob for e, scaled by E — 1.0 at perfect balance.
+    top1 = jax.nn.one_hot(top_i[..., 0], E, dtype=jnp.float32)
+    frac = top1.reshape(-1, E).mean(axis=0)
+    mean_p = probs.reshape(-1, E).mean(axis=0)
+    aux = E * jnp.sum(frac * mean_p)
+    return y, aux

@@ -39,10 +39,17 @@ def param_logical_axes(cfg: TransformerConfig) -> Params:
         "wv": ("layers", "embed", "kv_heads", "qkv_dim"),
         "wo": ("layers", "heads", "qkv_dim", "embed"),
         "mlp_norm": ("layers", "embed"),
-        "w_gate": ("layers", "embed", "mlp"),
-        "w_up": ("layers", "embed", "mlp"),
-        "w_down": ("layers", "mlp", "embed"),
     }
+    if cfg.moe_experts:
+        from ray_tpu.models.moe import moe_param_logical_axes
+
+        lay.update(moe_param_logical_axes())
+    else:
+        lay.update({
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        })
     axes = {
         "embed": ("vocab", "embed"),
         "layers": lay,
@@ -65,19 +72,28 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
     emb_scale = d ** -0.5
     in_scale = d ** -0.5
     out_scale = (2 * L) ** -0.5 * d ** -0.5  # depth-scaled residual outputs
-    params: Params = {
-        "embed": normal(next(k), (v, d), emb_scale),
-        "layers": {
-            "attn_norm": jnp.ones((L, d), pd),
-            "wq": normal(next(k), (L, d, H, hd), in_scale),
-            "wk": normal(next(k), (L, d, KV, hd), in_scale),
-            "wv": normal(next(k), (L, d, KV, hd), in_scale),
-            "wo": normal(next(k), (L, H, hd, d), out_scale),
-            "mlp_norm": jnp.ones((L, d), pd),
+    lay = {
+        "attn_norm": jnp.ones((L, d), pd),
+        "wq": normal(next(k), (L, d, H, hd), in_scale),
+        "wk": normal(next(k), (L, d, KV, hd), in_scale),
+        "wv": normal(next(k), (L, d, KV, hd), in_scale),
+        "wo": normal(next(k), (L, H, hd, d), out_scale),
+        "mlp_norm": jnp.ones((L, d), pd),
+    }
+    if cfg.moe_experts:
+        from ray_tpu.models.moe import init_moe_params
+
+        lay.update(init_moe_params(next(k), cfg))
+    else:
+        lay.update({
             "w_gate": normal(next(k), (L, d, ff), in_scale),
             "w_up": normal(next(k), (L, d, ff), in_scale),
-            "w_down": normal(next(k), (L, ff, d), out_scale * (ff / d) ** 0.5),
-        },
+            "w_down": normal(next(k), (L, ff, d),
+                             out_scale * (ff / d) ** 0.5),
+        })
+    params: Params = {
+        "embed": normal(next(k), (v, d), emb_scale),
+        "layers": lay,
         "final_norm": jnp.ones((d,), pd),
     }
     if not cfg.tie_embeddings:
@@ -131,8 +147,11 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh],
 # ---- forward ---------------------------------------------------------------
 
 def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
-            mesh: Optional[Mesh] = None) -> jax.Array:
-    """tokens [B, T] int32 -> logits [B, T, vocab] float32."""
+            mesh: Optional[Mesh] = None, return_aux: bool = False):
+    """tokens [B, T] int32 -> logits [B, T, vocab] float32.
+
+    With ``return_aux=True`` returns (logits, aux) where aux is the summed
+    MoE load-balance loss (0.0 for dense or pipelined execution)."""
     B, T = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]  # [B, T, d]
     x = _wlc(x, ("batch", "seq", "embed"), mesh=mesh)
@@ -155,18 +174,30 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
         x = x + _wlc(o, ("batch", "seq", "embed"), mesh=mesh)
 
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(cfg.dtype))
-        up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(cfg.dtype))
-        ff = jax.nn.silu(gate) * up
-        ff = _wlc(ff, ("batch", "seq", "mlp"), mesh=mesh)
-        down = jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(cfg.dtype))
+        if cfg.moe_experts:
+            from ray_tpu.models.moe import moe_ffn
+
+            down, aux = moe_ffn(h, lp, cfg, mesh)
+        else:
+            gate = jnp.einsum("btd,df->btf", h,
+                              lp["w_gate"].astype(cfg.dtype))
+            up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(cfg.dtype))
+            ff = jax.nn.silu(gate) * up
+            ff = _wlc(ff, ("batch", "seq", "mlp"), mesh=mesh)
+            down = jnp.einsum("btf,fd->btd", ff,
+                              lp["w_down"].astype(cfg.dtype))
+            aux = jnp.zeros((), jnp.float32)
         x = x + _wlc(down, ("batch", "seq", "embed"), mesh=mesh)
-        return x, None
+        # aux (MoE load-balance loss) rides the scan's per-layer outputs;
+        # the pipelined path drops it (pipeline stages emit activations
+        # only) — acceptable: aux is a regularizer, not the model output.
+        return x, aux
 
     body = block
     if cfg.remat:
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.nothing_saveable)
+    aux = jnp.zeros((), jnp.float32)
     if mesh is not None and mesh.shape.get("pipeline", 1) > 1:
         # GPipe-style microbatched stages over the pipeline mesh axis; the
         # same block body, numerically identical to the plain scan
@@ -176,13 +207,16 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
         x = pipeline_scan(body, x, params["layers"], mesh,
                           cfg.pipeline_microbatches)
     else:
-        x, _ = jax.lax.scan(lambda c, lp: body(c, lp), x, params["layers"])
+        x, layer_aux = jax.lax.scan(
+            lambda c, lp: body(c, lp), x, params["layers"])
+        aux = layer_aux.sum()
 
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
     logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
                         head.astype(jnp.float32))
-    return _wlc(logits, ("batch", "seq", "vocab"), mesh=mesh)
+    logits = _wlc(logits, ("batch", "seq", "vocab"), mesh=mesh)
+    return (logits, aux) if return_aux else logits
 
 
 def loss_fn(params: Params, batch: Dict[str, jax.Array],
@@ -196,7 +230,7 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
         toks = batch["tokens"]
         inputs, targets = toks[:, :-1], toks[:, 1:]
         mask = None
-    logits = forward(params, inputs, cfg, mesh)
+    logits, aux = forward(params, inputs, cfg, mesh, return_aux=True)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     nll = logz - gold
@@ -205,4 +239,9 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
         loss = (nll * mask).sum() / denom
     else:
         loss = nll.mean()
-    return loss, {"loss": loss, "perplexity": jnp.exp(loss)}
+    metrics = {"loss": loss, "perplexity": jnp.exp(loss)}
+    if cfg.moe_experts:
+        metrics["moe_aux"] = aux
+        loss = loss + cfg.moe_aux_weight * aux
+        metrics["total_loss"] = loss
+    return loss, metrics

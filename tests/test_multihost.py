@@ -126,3 +126,39 @@ def test_cluster_survives_remote_node_death(tcp_cluster):
         return x + 1
 
     assert ray_tpu.get(still_alive.remote(41), timeout=120) == 42
+
+
+def test_p2p_transfer_bypasses_head_memory(tcp_cluster):
+    """Cross-host objects must ride the direct agent<->agent (or
+    agent<->head-host) transfer plane, never relaying payload bytes
+    through head memory (ref: ObjectManager chunked pull,
+    src/ray/object_manager/ — the GCS never touches payloads)."""
+    import ray_tpu.core.api as core_api
+
+    cluster, handles = tcp_cluster
+    r1 = cluster.add_remote_node(num_cpus=1)
+    r2 = cluster.add_remote_node(num_cpus=1)
+    handles.extend([r1, r2])
+    head = core_api._head
+    head.relay_bytes = 0
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        r1.node_idx))
+    def produce():
+        return np.arange(500_000, dtype=np.float64)  # ~4 MB
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        r2.node_idx))
+    def consume(arr):
+        return float(arr.sum())
+
+    ref = produce.remote()
+    total = ray_tpu.get(consume.remote(ref), timeout=120)
+    assert total == float(np.arange(500_000, dtype=np.float64).sum())
+    assert head.relay_bytes == 0, (
+        f"{head.relay_bytes} bytes relayed through head memory")
+
+    # head-local driver fetch also rides P2P (head pulls from the agent)
+    arr = ray_tpu.get(ref, timeout=120)
+    assert arr.shape == (500_000,)
+    assert head.relay_bytes == 0
