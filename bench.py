@@ -43,14 +43,6 @@ def _peak_flops() -> float:
     return 197e12
 
 
-def _hbm_bytes() -> int:
-    try:
-        stats = jax.devices()[0].memory_stats()
-        return int(stats.get("bytes_limit", 0))
-    except Exception:
-        return 0
-
-
 def _fetch(x) -> float:
     """Force a genuine device->host value transfer (not just a ready-flag)."""
     return float(jax.device_get(x))
@@ -89,12 +81,10 @@ def _calibrate(peak: float) -> float:
     return rate
 
 
-def _pick_config(hbm: int):
-    """Largest built-in config whose train state fits the chip's HBM.
-
-    State bytes ~= num_params * 12 (fp32 master + 2 adam moments); leave
-    >=2.5x headroom for activations, gradients, and XLA temp buffers.
-    """
+def _candidate(name: str):
+    """Flagship candidates, largest first. The llama configs train with
+    bf16 master params + bf16 adam mu + fp32 nu — measured 49.8% MFU for
+    the 1B flagship on a single 16 GiB v5e chip."""
     from ray_tpu.models import (
         gpt2_small_config,
         llama3_8b_config,
@@ -102,36 +92,42 @@ def _pick_config(hbm: int):
     )
     from ray_tpu.models.config import llama3_1b_config
 
-    if jax.default_backend() == "cpu":
-        return tiny_config(max_seq_len=128), 8, 128, 5
-    candidates = [
-        (llama3_8b_config(max_seq_len=4096), 4, 4096, 5),
-        (llama3_1b_config(), 8, 4096, 10),
-        (gpt2_small_config(), 16, 1024, 20),
-    ]
-    for cfg, bs, seq, steps in candidates:
-        need = cfg.num_params * 12 * 2.5
-        if hbm and need < hbm:
-            return cfg, bs, seq, steps
-    return candidates[-1]
+    bf16 = dict(param_dtype=jnp.bfloat16)
+    lean_opt = dict(mu_dtype=jnp.bfloat16)
+    table = {
+        "llama3-8b": (llama3_8b_config(max_seq_len=2048, **bf16),
+                      4, 2048, 5, lean_opt),
+        "llama3-1b": (llama3_1b_config(max_seq_len=2048, **bf16),
+                      4, 2048, 10, lean_opt),
+        "gpt2-small": (gpt2_small_config(), 16, 1024, 20, {}),
+        "tiny-cpu": (tiny_config(max_seq_len=128), 8, 128, 5, {}),
+    }
+    return table[name]
 
 
-def main():
+CANDIDATE_ORDER = ("llama3-8b", "llama3-1b", "gpt2-small")
+
+
+def _run_single(cfg_name: str) -> None:
+    """Measure ONE config on the attached device; exits 3 if the backend
+    turns out to be CPU for a non-CPU candidate (caller falls back)."""
     from ray_tpu.models import (
         init_train_state,
         make_optimizer,
         make_train_step,
     )
 
+    if jax.default_backend() == "cpu" and cfg_name != "tiny-cpu":
+        sys.exit(3)
     peak = _peak_flops()
     matmul_rate = _calibrate(peak)
+    cfg, batch_size, seq, steps, opt_kw = _candidate(cfg_name)
+    print(f"# config={cfg_name} bs={batch_size} seq={seq} "
+          f"({cfg.num_params / 1e9:.2f}B params)", file=sys.stderr)
 
-    cfg, batch_size, seq, steps = _pick_config(_hbm_bytes())
-
-    tx = make_optimizer(3e-4)
+    tx = make_optimizer(3e-4, **opt_kw)
     state = init_train_state(jax.random.key(0), cfg, tx)
     step = make_train_step(cfg, tx)
-
     toks = jax.random.randint(jax.random.key(1), (batch_size, seq + 1), 0,
                               cfg.vocab_size, dtype=jnp.int32)
     batch = {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
@@ -167,7 +163,65 @@ def main():
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.40, 4),
+        "config": cfg_name,
+        "mfu": round(mfu, 4),
     }))
+
+
+def main():
+    """Try candidates largest-first, EACH IN ITS OWN SUBPROCESS.
+
+    Two observed backend behaviors force this structure: (a) a failed
+    too-big allocation wedges this backend's allocator so later small
+    allocations in the same process also fail (in-process step-down would
+    cascade to total failure), and (b) allocation probes lie (multi-100-GiB
+    ``jnp.zeros`` "succeeds" lazily), so fit can only be tested by really
+    running the config. The parent never touches the device — the tunnel
+    backend serializes access to a single holder.
+    """
+    import os
+    import subprocess
+
+    if len(sys.argv) > 2 and sys.argv[1] == "--config":
+        _run_single(sys.argv[2])
+        return
+    here = os.path.abspath(__file__)
+
+    def run_child(cfg_name: str):
+        try:
+            return subprocess.run(
+                [sys.executable, here, "--config", cfg_name],
+                capture_output=True, text=True, timeout=3600)
+        except subprocess.TimeoutExpired as e:
+            # a wedged child (hung allocator) must step down, not crash
+            # the bench without its JSON line
+            print(f"# {cfg_name} timed out after {e.timeout}s",
+                  file=sys.stderr)
+            return None
+
+    for name in CANDIDATE_ORDER:
+        proc = run_child(name)
+        if proc is None:
+            continue
+        sys.stderr.write(proc.stderr)
+        if proc.returncode == 0 and proc.stdout.strip():
+            sys.stdout.write(proc.stdout)
+            return
+        if proc.returncode == 3:
+            # CPU backend: run the smoke-test config directly
+            proc = run_child("tiny-cpu")
+            if proc is not None:
+                sys.stderr.write(proc.stderr)
+                sys.stdout.write(proc.stdout)
+                sys.exit(proc.returncode)
+            break
+        print(f"# {name} failed (rc={proc.returncode}); stepping down",
+              file=sys.stderr)
+    print(json.dumps({
+        "metric": "train_step_tokens_per_sec_per_chip",
+        "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+        "error": "every candidate config failed on this device"}))
+    sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -101,13 +101,17 @@ class TransferServer:
 
 
 class _PullState:
-    __slots__ = ("buf", "done", "error", "conn")
+    __slots__ = ("buf", "done", "error", "conn", "buf_lock")
 
     def __init__(self, conn: P.Connection):
         self.buf = None
         self.done = threading.Event()
         self.error: Optional[str] = None
         self.conn = conn
+        # serializes chunk writes against the abort path's buf=None +
+        # arena delete — a copy into a freed (and possibly reallocated)
+        # arena slot would corrupt another object
+        self.buf_lock = threading.Lock()
 
 
 class ObjectPuller:
@@ -167,9 +171,12 @@ class ObjectPuller:
             if st.error is not None and not self._store.contains(oid):
                 # never leave a created-but-unsealed entry behind: it would
                 # poison every retry (create fails on existing ids) while
-                # readers block forever on an object that never seals
-                st.buf = None
-                self._store.delete(oid)
+                # readers block forever on an object that never seals.
+                # buf_lock: an in-flight chunk copy must finish before the
+                # arena slot is freed.
+                with st.buf_lock:
+                    st.buf = None
+                    self._store.delete(oid)
             st.done.set()
         return st.error is None
 
@@ -220,17 +227,21 @@ class ObjectPuller:
             payload = msg[2]
             with self._lock:
                 st = self._pending.get(oid)
-            buf = st.buf if st is not None else None
-            if buf is not None:
-                import numpy as np
+            if st is not None:
+                with st.buf_lock:
+                    buf = st.buf
+                    if buf is not None:
+                        import numpy as np
 
-                # vectorized copy into the arena (~2x a memoryview slice
-                # assignment; this is the receive-side hot loop). payload
-                # may be a memoryview into the recv buffer (feed()'s
-                # zero-copy fast path) — consumed before returning.
-                np.copyto(
-                    np.frombuffer(buf[off:off + len(payload)], np.uint8),
-                    np.frombuffer(payload, np.uint8))
+                        # vectorized copy into the arena (~2x a memoryview
+                        # slice assignment; this is the receive-side hot
+                        # loop). payload may be a memoryview into the recv
+                        # buffer (feed()'s zero-copy fast path) — consumed
+                        # before returning.
+                        np.copyto(
+                            np.frombuffer(buf[off:off + len(payload)],
+                                          np.uint8),
+                            np.frombuffer(payload, np.uint8))
         elif mt == P.OBJ_PULL_DONE:
             oid = ObjectID(msg[2])
             with self._lock:

@@ -21,7 +21,9 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
-_LEN = struct.Struct("<I")
+# 8-byte length prefix: the top bit marks RAW frames, and pickled frames of
+# several GiB (relay fallback of large spilled objects) must still fit.
+_LEN = struct.Struct("<Q")
 
 # --- message types ---------------------------------------------------------
 # worker <-> head (GCS + raylet services)
@@ -95,7 +97,7 @@ OBJ_PULL_META = 61      # server->puller: (oid_bin, size|-1, meta_bytes)
 # unpickled bytes (bulk data follows its pickled header message). Sending
 # side writes straight from a memoryview (e.g. an shm arena slice) with
 # zero serialization copies.
-_RAW_BIT = 0x8000_0000
+_RAW_BIT = 1 << 63
 
 
 class ConnectionLost(Exception):
@@ -240,35 +242,36 @@ class Connection:
         finishes iterating the returned list (the transfer plane consumes
         them synchronously).
         """
+        hdr = _LEN.size
         msgs = []
         if not self._rbuf:
             src = memoryview(data)
             pos, n = 0, len(src)
-            while n - pos >= 4:
+            while n - pos >= hdr:
                 (ln,) = _LEN.unpack_from(src, pos)
                 raw = bool(ln & _RAW_BIT)
                 ln &= ~_RAW_BIT
-                if n - pos - 4 < ln:
+                if n - pos - hdr < ln:
                     break
-                payload = src[pos + 4:pos + 4 + ln]
+                payload = src[pos + hdr:pos + hdr + ln]
                 msgs.append((RAW_FRAME, 0, payload) if raw
                             else pickle.loads(payload))
-                pos += 4 + ln
+                pos += hdr + ln
             if pos < n:
                 self._rbuf += src[pos:]
             return msgs
         # slow path: a partial frame spans recv() calls — buffer and copy
         self._rbuf += data
         while True:
-            if len(self._rbuf) < 4:
+            if len(self._rbuf) < hdr:
                 break
             (ln,) = _LEN.unpack_from(self._rbuf)
             raw = bool(ln & _RAW_BIT)
             ln &= ~_RAW_BIT
-            if len(self._rbuf) < 4 + ln:
+            if len(self._rbuf) < hdr + ln:
                 break
-            payload = bytes(self._rbuf[4:4 + ln])
-            del self._rbuf[:4 + ln]
+            payload = bytes(self._rbuf[hdr:hdr + ln])
+            del self._rbuf[:hdr + ln]
             msgs.append((RAW_FRAME, 0, payload) if raw
                         else pickle.loads(payload))
         return msgs

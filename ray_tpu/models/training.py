@@ -30,7 +30,14 @@ TrainState = Dict[str, Any]  # {"step", "params", "opt_state"}
 
 def make_optimizer(learning_rate: float = 3e-4, *, weight_decay: float = 0.1,
                    b1: float = 0.9, b2: float = 0.95, grad_clip: float = 1.0,
-                   warmup_steps: int = 0, total_steps: Optional[int] = None):
+                   warmup_steps: int = 0, total_steps: Optional[int] = None,
+                   mu_dtype=None):
+    """AdamW + global-norm clip (+ optional warmup-cosine schedule).
+
+    ``mu_dtype=jnp.bfloat16`` halves the first-moment buffer — with fp32
+    master params + fp32 nu that's params x 10 bytes instead of x 12,
+    which is what lets the 1B flagship train on a single 16 GiB chip.
+    """
     if warmup_steps or total_steps:
         schedule = optax.warmup_cosine_decay_schedule(
             0.0, learning_rate, max(warmup_steps, 1),
@@ -39,7 +46,8 @@ def make_optimizer(learning_rate: float = 3e-4, *, weight_decay: float = 0.1,
         schedule = learning_rate
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
-        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay,
+                    mu_dtype=mu_dtype),
     )
 
 
