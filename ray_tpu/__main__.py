@@ -1,0 +1,7 @@
+"""Entry point: ``python -m ray_tpu <command>`` (the reference's `ray` CLI)."""
+
+import sys
+
+from ray_tpu.scripts import main
+
+sys.exit(main())

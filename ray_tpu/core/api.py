@@ -36,13 +36,20 @@ def init(*, num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
          object_store_memory: Optional[int] = None, resources: dict = None,
          labels: dict = None, _system_config: dict = None,
          ignore_reinit_error: bool = False, log_to_driver: bool = True,
-         namespace: str = "", address: Optional[str] = None) -> "RuntimeInfo":
+         namespace: str = "", address: Optional[str] = None,
+         session_dir: Optional[str] = None) -> "RuntimeInfo":
     """Start (or connect to) a runtime.
 
     With no address, starts an embedded head (GCS-lite + one node) in this
     process — the reference's ``ray.init()`` local mode with real worker
     processes. ``address`` may name an existing head socket to attach to
     (multi-driver; the reference's ``ray.init(address=...)``).
+
+    ``session_dir`` pins the session directory. Reusing a previous
+    session's directory restores the head's durable control-plane state
+    (KV, named actors, placement groups) from its write-ahead log — the
+    reference's GCS restart from Redis (gcs fault tolerance docs;
+    src/ray/gcs/store_client/).
     """
     global _head
     with _init_lock:
@@ -60,9 +67,12 @@ def init(*, num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
             ctx = CoreContext(head_addr=address, session_dir=session_dir,
                               node_idx=0, is_driver=True)
             set_context(ctx)
+            if log_to_driver:
+                _mirror_worker_logs(ctx)
             return RuntimeInfo(ctx, None)
         session_name = uuid.uuid4().hex[:10]
-        session_dir = f"/tmp/ray_tpu/session_{session_name}"
+        if session_dir is None:
+            session_dir = f"/tmp/ray_tpu/session_{session_name}"
         os.makedirs(session_dir, exist_ok=True)
         head = Head(session_dir, session_name)
         head.add_node(num_cpus=num_cpus, num_tpus=num_tpus,
@@ -72,9 +82,24 @@ def init(*, num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
         ctx = CoreContext(head_addr=head.addr, session_dir=session_dir,
                           node_idx=0, is_driver=True)
         set_context(ctx)
+        if log_to_driver:
+            _mirror_worker_logs(ctx)
         _head = head
         atexit.register(shutdown)
         return RuntimeInfo(ctx, head)
+
+
+def _mirror_worker_logs(ctx: CoreContext):
+    """Print worker log lines in the driver, prefixed with their source
+    (reference: worker.py print_logs fed by log_monitor.py over pubsub)."""
+    import sys as _sys
+
+    def _print(data):
+        src = data.get("source", "?")
+        for line in data.get("lines", ()):
+            print(f"({src}) {line}", file=_sys.stderr)
+
+    ctx.subscribe("logs", _print)
 
 
 class RuntimeInfo:
@@ -184,6 +209,13 @@ class RemoteFunction:
             name=self._name)
         return refs[0] if self._num_returns == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node instead of executing (reference:
+        ray.dag, dag_node.py:23)."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def options(self, **opts) -> "RemoteFunction":
         merged = dict(
             num_returns=self._num_returns,
@@ -279,6 +311,12 @@ class ActorClass:
             max_task_retries=self._max_task_retries)
         return ActorHandle(actor_id, _public_methods(self._cls),
                            self._max_task_retries)
+
+    def bind(self, *args, **kwargs):
+        """Build a lazy actor DAG node (reference: ray.dag class_node.py)."""
+        from ray_tpu.dag import ClassNode
+
+        return ClassNode(self, args, kwargs)
 
     def options(self, **opts) -> "ActorClass":
         base = dict(num_cpus=None, num_tpus=None, resources=None,

@@ -71,6 +71,15 @@ class Config:
     health_check_period_s: float = 3.0
     health_check_failure_threshold: int = 5
     task_max_retries_default: int = 3
+    # Owner-side lineage cache: plasma-resident task results whose creating
+    # TaskSpec is retained for reconstruction after node loss (reference:
+    # lineage_pinning + ObjectRecoveryManager, object_recovery_manager.h:41).
+    lineage_cache_max_entries: int = 4096
+    # Attempts to re-execute a creating task when recovering a lost object.
+    object_recovery_max_attempts: int = 3
+    # Durable head WAL (reference: GCS Redis-backed store client —
+    # redis_store_client.h). Restores KV / named actors / PGs on restart.
+    head_persistence: bool = True
 
     # --- logging / events ---
     log_dir: str = ""

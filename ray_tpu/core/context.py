@@ -20,7 +20,7 @@ import queue as queue_mod
 import threading
 import time
 import traceback
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import protocol as P
@@ -35,6 +35,7 @@ from .object_ref import ObjectRef
 from .object_store import ShmObjectStore
 from .ref_counter import ReferenceCounter
 from .serialization import SerializedValue, deserialize, serialize
+from . import events as task_events
 from .task_spec import (ARG_REF, ARG_VALUE, SchedulingStrategy, TaskSpec,
                         TaskType)
 
@@ -178,10 +179,25 @@ class CoreContext:
 
         self.fn_manager = FunctionManager(self.kv_put, self.kv_get)
 
+        # task-state events -> head ring buffer (state API / `list tasks`)
+        self.events = task_events.TaskEventBuffer(
+            self.head, self.worker_id, node_idx)
+        self.events.start()
+
         # submitter
         self._classes: Dict[tuple, _ClassState] = {}
         self._inflight: Dict[TaskID, _InflightTask] = {}
         self._return_to_task: Dict[ObjectID, TaskID] = {}
+        # Lineage cache: plasma-resident task results -> creating TaskSpec,
+        # kept past task completion so a lost object can be reconstructed by
+        # re-executing its task (reference: lineage pinning in the owner's
+        # ReferenceCounter + ObjectRecoveryManager::RecoverObject,
+        # object_recovery_manager.h:41). FIFO-capped; put() objects are
+        # not reconstructable, matching the reference.
+        self._lineage: "OrderedDict[ObjectID, TaskSpec]" = OrderedDict()
+        self._recovering: set = set()  # TaskIDs being re-executed
+        # borrowed-ref owners, for routing reconstruction requests
+        self._known_owners: Dict[ObjectID, str] = {}
         self._dep_unready: set = set()  # actor tasks awaiting arg resolution
         self._sub_lock = threading.RLock()
         self._submit_event = threading.Event()
@@ -229,6 +245,12 @@ class CoreContext:
             self.ref_counter.add_borrower(ObjectID(msg[2]), msg[3])
         elif mt == P.BORROW_REMOVE:
             self.ref_counter.remove_borrower(ObjectID(msg[2]), msg[3])
+        elif mt == P.RECOVER_OBJECT:
+            # a borrower hit a lost object we own — reconstruct off the IO
+            # thread (recovery does blocking head calls)
+            oid = ObjectID(msg[2])
+            threading.Thread(target=self._recover_object, args=(oid,),
+                             daemon=True).start()
         elif mt == P.KILL_ACTOR:
             os._exit(0)
 
@@ -330,18 +352,55 @@ class CoreContext:
         return fut
 
     def _resolve_value(self, oid: ObjectID) -> Any:
-        e = self.memory_store.peek(oid)
-        if e is None:
-            raise ObjectLostError(oid.hex())
-        if e.in_plasma and e.value is None:
-            value = self._fetch_from_plasma(oid, e.node_idx)
-            e.value = value
-        if e.is_error:
-            err = e.value
-            if isinstance(err, TaskError):
-                raise RayTaskError(err)
-            raise err
-        return e.value
+        attempts = get_config().object_recovery_max_attempts
+        last_err: Optional[Exception] = None
+        for attempt in range(attempts + 1):
+            e = self.memory_store.peek(oid)
+            if e is None:
+                # a concurrent _recover_object evicts the entry before the
+                # re-executed task reseals it — wait, don't declare lost
+                with self._sub_lock:
+                    pending = oid in self._return_to_task
+                if pending:
+                    if not self.memory_store.wait_ready([oid], 1,
+                                                        timeout=120):
+                        raise GetTimeoutError(
+                            f"timed out reconstructing {oid.hex()}")
+                    continue
+                raise ObjectLostError(oid.hex())
+            if e.is_error:
+                err = e.value
+                if isinstance(err, TaskError):
+                    raise RayTaskError(err)
+                raise err
+            if not e.in_plasma or e.value is not None:
+                return e.value
+            try:
+                e.value = self._fetch_from_plasma(oid, e.node_idx)
+                return e.value
+            except GetTimeoutError:
+                raise
+            except Exception as fetch_err:  # noqa: BLE001 — copies lost
+                last_err = fetch_err
+                if attempt >= attempts:
+                    break
+                if self._recover_object(oid):
+                    if not self.memory_store.wait_ready([oid], 1,
+                                                        timeout=120):
+                        raise GetTimeoutError(
+                            f"timed out reconstructing {oid.hex()}")
+                    continue
+                owner = self._known_owners.get(oid)
+                if not owner or owner == self.worker_id:
+                    break
+                # borrowed ref: the lineage lives with the owner — ask it
+                # to reconstruct, then re-locate (blocking) from scratch
+                self.memory_store.evict(oid)
+                self._pinned.discard(oid)
+                self._background_fetch(oid)
+        raise ObjectLostError(
+            f"{oid.hex()}: all copies lost and not reconstructable "
+            f"({last_err})") from last_err
 
     def _fetch_from_plasma(self, oid: ObjectID, node_idx: int) -> Any:
         if node_idx != self.node_idx or not self.store.contains(oid):
@@ -370,17 +429,111 @@ class CoreContext:
             t.start()
 
     def _background_fetch(self, oid: ObjectID):
+        attempts = get_config().object_recovery_max_attempts
+        for attempt in range(attempts + 1):
+            try:
+                node_idx, size, spilled = self.head.call(
+                    P.OBJECT_LOCATE, oid.binary(), True, timeout=None)
+            except Exception:
+                return
+            if node_idx != -2:
+                self.memory_store.put_plasma_location(oid, node_idx)
+                return
+            # lost with its node — reconstruct (we own it) or ask the
+            # owner, who holds the lineage, to (we borrowed it)
+            if self._recover_object(oid):
+                return  # re-execution repopulates the entry on reply
+            owner = self._known_owners.get(oid)
+            if owner and owner != self.worker_id and attempt < attempts:
+                try:
+                    self.head.send(P.RECOVER_OBJECT, oid.binary(), owner)
+                except P.ConnectionLost:
+                    break
+                # give the owner a beat to clear the LOST marker, then the
+                # blocking locate above waits for the re-seal
+                time.sleep(0.2 * (attempt + 1))
+                continue
+            break
+        self.memory_store.put_value(
+            oid, ObjectLostError(
+                f"{oid.hex()}: all copies lost and no lineage"),
+            is_error=True)
+
+    def _recover_object(self, oid: ObjectID) -> bool:
+        """Lineage reconstruction (reference: ObjectRecoveryManager::
+        RecoverObject, object_recovery_manager.h:41): re-execute the task
+        that created a lost object, reusing its TaskID so the re-sealed
+        results land under the same ObjectIDs consumers already hold.
+        Returns False when the object has no retained lineage (e.g. a
+        put() object, or evicted from the FIFO lineage cache)."""
+        with self._sub_lock:
+            spec = self._lineage.get(oid)
+            if spec is None:
+                return False
+            if spec.task_id in self._recovering or \
+                    spec.task_id in self._inflight:
+                return True  # re-execution already underway
+            self._recovering.add(spec.task_id)
+        returns = spec.return_ids()
+        # Un-mark LOST head-side so consumers' blocking locates queue for
+        # the re-seal instead of failing fast.
         try:
-            node_idx, size, spilled = self.head.call(
-                P.OBJECT_LOCATE, oid.binary(), True, timeout=None)
-            self.memory_store.put_plasma_location(oid, node_idx)
-        except Exception:
-            pass
+            self.head.send(P.OBJECT_RECOVERING,
+                           [r.binary() for r in returns])
+        except P.ConnectionLost:
+            with self._sub_lock:
+                self._recovering.discard(spec.task_id)
+            return False
+        # Recover lost plasma args first (recursive lineage walk): the
+        # executing worker's blocking locate then waits for their re-seal.
+        # An arg that is lost AND unrecoverable (freed, or lineage evicted)
+        # aborts the whole recovery — enqueueing anyway would wedge the
+        # executing worker on a locate that can never be answered.
+        for enc in spec.args:
+            if enc[0] != ARG_REF:
+                continue
+            aid = ObjectID(enc[1])
+            e = self.memory_store.peek(aid)
+            if e is not None and not e.in_plasma:
+                continue  # inline value still in the in-process store
+            try:
+                node_idx, _, spilled = self.head.call(
+                    P.OBJECT_LOCATE, aid.binary(), False, timeout=30)
+            except Exception:  # noqa: BLE001
+                continue
+            if node_idx == -2 or (node_idx < 0 and not spilled):
+                if not self._recover_object(aid):
+                    with self._sub_lock:
+                        self._recovering.discard(spec.task_id)
+                    return False
+        # Register the re-execution BEFORE evicting the stale entries:
+        # concurrent getters that peek a missing entry check
+        # _return_to_task and wait instead of raising ObjectLostError.
+        if spec.strategy.kind == "NODE_AFFINITY":
+            # the original placement may name a dead node — reconstruction
+            # is free to run anywhere
+            spec.strategy = SchedulingStrategy()
+        inflight = _InflightTask(spec, [], spec.max_retries, [])
+        cls = spec.scheduling_class()
+        with self._sub_lock:
+            self._inflight[spec.task_id] = inflight
+            for roid in returns:
+                self._return_to_task[roid] = spec.task_id
+        for roid in returns:
+            self.memory_store.evict(roid)
+            self._pinned.discard(roid)
+        with self._sub_lock:
+            st = self._classes.setdefault(cls, _ClassState())
+            st.queue.append(spec)
+        self._submit_event.set()
+        return True
 
     # ================================================== GC callbacks
 
     def _free_owned_object(self, oid: ObjectID):
         self._contained.pop(oid, None)
+        with self._sub_lock:
+            self._lineage.pop(oid, None)
         self.memory_store.evict(oid)
         if oid in self._pinned:
             self._pinned.discard(oid)
@@ -394,6 +547,7 @@ class CoreContext:
             pass
 
     def _release_borrow(self, oid: ObjectID, owner: str):
+        self._known_owners.pop(oid, None)
         self.memory_store.evict(oid)
         if oid in self._pinned:
             self._pinned.discard(oid)
@@ -409,6 +563,7 @@ class CoreContext:
 
     def notify_deserialized_ref(self, ref: ObjectRef):
         if ref.owner and ref.owner != self.worker_id:
+            self._known_owners[ref.id] = ref.owner
             try:
                 self.head.send(P.BORROW_ADD, ref.id.binary(), ref.owner,
                                self.worker_id)
@@ -436,6 +591,7 @@ class CoreContext:
             owner=self.worker_id,
         )
         arg_ids, holder = self._encode_args(spec, args, kwargs)
+        self.events.record(task_id.hex(), spec.name, task_events.SUBMITTED)
         return self._enqueue_spec(spec, arg_ids, holder)
 
     def _encode_args(self, spec: TaskSpec, args, kwargs):
@@ -786,16 +942,27 @@ class CoreContext:
             self._complete_task_error(spec, err)
 
     def _complete_task_error(self, spec: TaskSpec, err: Exception):
+        aborted = []
         for oid in spec.return_ids():
             # don't clobber results that already arrived (e.g. an actor
             # killed right after its last reply was stored)
             if not self.memory_store.contains(oid):
                 self.memory_store.put_value(oid, err, is_error=True)
+                aborted.append(oid.binary())
+        if aborted and spec.task_type == TaskType.NORMAL:
+            # borrowers may be blocked in a head-side locate for these
+            # returns (esp. after a failed lineage re-execution) — tell
+            # the head they will never seal
+            try:
+                self.head.send(P.SEAL_ABORTED, aborted)
+            except P.ConnectionLost:
+                pass
         self._finalize_task(spec)
 
     def _finalize_task(self, spec: TaskSpec):
         with self._sub_lock:
             inf = self._inflight.pop(spec.task_id, None)
+            self._recovering.discard(spec.task_id)
             for oid in spec.return_ids():
                 self._return_to_task.pop(oid, None)
         if inf is not None:
@@ -864,12 +1031,26 @@ class CoreContext:
         self._submit_event.set()
 
     def _store_results(self, spec: TaskSpec, result_meta):
+        any_plasma = False
         for oid, entry in zip(spec.return_ids(), result_meta):
             kind = entry[0]
             if kind == "v":
                 self.memory_store.put_value(oid, deserialize(entry[1]))
             else:
                 self.memory_store.put_plasma_location(oid, entry[1])
+                any_plasma = True
+        if any_plasma and spec.task_type == TaskType.NORMAL:
+            self._record_lineage(spec)
+
+    def _record_lineage(self, spec: TaskSpec):
+        cap = get_config().lineage_cache_max_entries
+        with self._sub_lock:
+            self._recovering.discard(spec.task_id)
+            for oid in spec.return_ids():
+                self._lineage[oid] = spec
+                self._lineage.move_to_end(oid)
+            while len(self._lineage) > cap:
+                self._lineage.popitem(last=False)
 
     # ================================================== actor submission
 
@@ -888,6 +1069,7 @@ class CoreContext:
             task_id=task_id, job_id=self.job_id,
             task_type=TaskType.ACTOR_CREATION,
             name=name, function_id=fn_id,
+            class_name=getattr(cls, "__name__", ""),
             resources=res,
             strategy=strategy or SchedulingStrategy(),
             owner=self.worker_id, actor_id=actor_id,
@@ -1193,6 +1375,20 @@ class CoreContext:
     def _execute(self, spec: TaskSpec, conn: P.Connection):
         """Run one task; returns the TASK_REPLY fields (or None when the
         reply was already sent inline — creation/terminate paths)."""
+        label = spec.name or spec.method_name or spec.function_id
+        self.events.record(spec.task_id.hex(), label, task_events.RUNNING)
+        out = self._execute_inner(spec, conn)
+        if out is None or out[1] == "ok":
+            self.events.record(spec.task_id.hex(), label,
+                               task_events.FINISHED)
+        else:
+            self.events.record(
+                spec.task_id.hex(), label,
+                task_events.FAILED if out[1] == "error" else out[1].upper(),
+                error=repr(out[3]) if out[3] is not None else "")
+        return out
+
+    def _execute_inner(self, spec: TaskSpec, conn: P.Connection):
         if spec.task_id in self._cancelled:
             return (spec.task_id.binary(), "cancelled", None, None)
         self.current_task_id = spec.task_id
@@ -1289,7 +1485,10 @@ class CoreContext:
                 meta.append(("v", [bytes(f) if isinstance(f, memoryview)
                                    else f for f in sv.frames]))
             else:
-                self.store.put_serialized(oid, sv.frames)
+                # contains() guard: lineage reconstruction can re-run a task
+                # on a node that still holds the previous copy of its result
+                if not self.store.contains(oid):
+                    self.store.put_serialized(oid, sv.frames)
                 self.head.send(P.OBJECT_SEALED, oid.binary(), self.node_idx,
                                sv.total_bytes, spec.owner)
                 meta.append(("p", self.node_idx))
@@ -1310,6 +1509,7 @@ class CoreContext:
 
     def shutdown(self):
         self._shutdown = True
+        self.events.stop()
         self._submit_event.set()
         with self._sub_lock:
             for st in self._classes.values():

@@ -1,0 +1,81 @@
+"""Task execution events: worker-side buffer -> head ring buffer.
+
+The reference buffers task state transitions in each core worker and
+flushes them to the GCS for the observability APIs
+(src/ray/core_worker/task_event_buffer.h:199, flush period 1s, bounded
+buffer with drop counting; surfaced by `ray list tasks` /
+python/ray/util/state/api.py). Same shape here: every CoreContext owns a
+TaskEventBuffer; a daemon flusher batches events to the head over the
+existing connection (P.TASK_EVENTS), and the head keeps a bounded deque the
+state API queries. Overflow drops the oldest events and counts the drops —
+observability must never backpressure the task path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from . import protocol as P
+from .config import get_config
+
+# task states (reference: src/ray/protobuf/common.proto TaskStatus)
+SUBMITTED = "SUBMITTED"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+
+FLUSH_PERIOD_S = 1.0
+
+
+class TaskEventBuffer:
+    """Owner/executor-side event buffer with periodic batched flush."""
+
+    def __init__(self, head_conn, worker_id: str, node_idx: int):
+        self._head = head_conn
+        self._worker_id = worker_id
+        self._node_idx = node_idx
+        self._lock = threading.Lock()
+        self._max = get_config().task_event_buffer_size
+        # deque(maxlen): O(1) drop-oldest when the flusher falls behind
+        # (list.pop(0) would be O(n) on the task hot path)
+        self._events: "deque" = deque(maxlen=self._max)
+        self._dropped = 0
+        self._stop = threading.Event()
+        self._flusher: Optional[threading.Thread] = None
+
+    def start(self):
+        self._flusher = threading.Thread(target=self._flush_loop,
+                                         daemon=True, name="task-events")
+        self._flusher.start()
+
+    def record(self, task_id_hex: str, name: str, state: str,
+               error: str = ""):
+        ev = (task_id_hex, name, state, self._worker_id, self._node_idx,
+              time.time(), error)
+        with self._lock:
+            if len(self._events) == self._max:
+                self._dropped += 1  # deque(maxlen) evicts the oldest
+            self._events.append(ev)
+
+    def _flush_loop(self):
+        while not self._stop.wait(FLUSH_PERIOD_S):
+            self.flush()
+
+    def flush(self):
+        with self._lock:
+            if not self._events:
+                return
+            batch = list(self._events)
+            self._events.clear()
+            dropped, self._dropped = self._dropped, 0
+        try:
+            self._head.send(P.TASK_EVENTS, batch, dropped)
+        except P.ConnectionLost:
+            pass
+
+    def stop(self):
+        self._stop.set()
+        self.flush()

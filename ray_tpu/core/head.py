@@ -24,6 +24,7 @@ import sys
 import threading
 import time
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -32,6 +33,7 @@ from .protocol import local_ip as _local_ip
 from .config import get_config
 from .ids import ActorID, ObjectID, PlacementGroupID
 from .object_store import ShmObjectStore
+from .persistence import HeadStore
 from .resources import NodeResources, ResourceSet, detect_node_resources
 from .scheduler import ClusterResourceScheduler
 from .serialization import dumps, loads
@@ -144,9 +146,42 @@ class Head:
         # bytes relayed through head memory on the legacy path — the P2P
         # tests assert this stays 0 for host<->host transfers
         self.relay_bytes = 0
+        # Objects that were sealed and then lost with their node (no other
+        # copy, not spilled). A locate on these answers -2 immediately so
+        # owners can run lineage reconstruction instead of blocking forever
+        # (reference: ObjectRecoveryManager, object_recovery_manager.h:41).
+        # Insertion-ordered dict, FIFO-capped: ids whose owner died with
+        # the node are never recovered/freed and would otherwise leak.
+        self.lost_objects: Dict[ObjectID, None] = {}
+        # Task-event ring buffer feeding the state API (reference:
+        # GcsTaskManager over task_event_buffer.h flushes).
+        self.task_events: "deque" = deque(
+            maxlen=get_config().task_event_buffer_size)
+        self.task_events_dropped = 0
+        self._log_monitor = None
+        # Durable control-plane WAL (reference: GCS Redis store client).
+        self._persist: Optional[HeadStore] = None
+        self._wal_backlog: List[tuple] = []  # records queued under _lock
+        self._restored_actor_specs: List[bytes] = []
+        self._restored_pg_specs: List[bytes] = []
+        if get_config().head_persistence:
+            self._persist = HeadStore(session_dir)
+            state = self._persist.restore()
+            if state:
+                self.kv = {ns: dict(t) for ns, t in state["kv"].items()}
+                self._restored_actor_specs = list(state["actors"].values())
+                self._restored_pg_specs = list(state["pgs"].values())
 
     def start(self):
         self.io.start()
+        # Tail worker log files -> "logs" pubsub channel; drivers mirror
+        # them when log_to_driver=True (reference: log_monitor.py:103).
+        from .log_monitor import LogMonitor
+
+        self._log_monitor = LogMonitor(
+            self.session_dir,
+            lambda ch, data: self._publish(ch, dumps(data)))
+        self._log_monitor.start()
         # Housekeeping loop: pending-PG retries and idle-worker reaping
         # must not depend on any client calling in — a placement group
         # that couldn't be placed at creation (resources transiently held
@@ -233,7 +268,41 @@ class Head:
         with self._lock:
             self.nodes[idx] = node
             self.scheduler.add_node(idx, nr)
+        self._flush_restored()
         return idx
+
+    def _flush_restored(self):
+        """Reschedule durable entities replayed from a previous head's WAL,
+        now that a node exists to place them on (reference: GCS failover
+        reschedules detached actors / placement groups from the Redis
+        tables — gcs_actor_manager.cc, gcs_placement_group_manager.cc)."""
+        with self._lock:
+            pg_specs, self._restored_pg_specs = self._restored_pg_specs, []
+            a_specs, self._restored_actor_specs = \
+                self._restored_actor_specs, []
+        for sb in pg_specs:
+            spec: PlacementGroupSpec = loads(sb)
+            with self._lock:
+                if spec.pg_id in self.pgs:
+                    continue
+                placement = self.scheduler.place_bundles(spec)
+                if placement is None:
+                    self.pgs[spec.pg_id] = PgInfo(spec=spec)
+                    self._pending_pg.append(spec.pg_id)
+                else:
+                    self._commit_pg(spec, placement)
+        for sb in a_specs:
+            spec = loads(sb)
+            info = ActorInfo(actor_id=spec.actor_id, spec=spec,
+                             name=spec.name or "")
+            with self._lock:
+                if spec.actor_id in self.actors or (
+                        info.name and info.name in self.named_actors):
+                    continue
+                self.actors[spec.actor_id] = info
+                if info.name:
+                    self.named_actors[info.name] = spec.actor_id
+            self._schedule_actor(info)
 
     def register_remote_node(self, conn: P.Connection, resources,
                              store_name: str, node_ip: str,
@@ -253,6 +322,7 @@ class Head:
         conn.peer = f"agent:node{idx}"
         conn.on_close = lambda c, i=idx: self._on_agent_close(i)
         self._publish("node_added", dumps(idx))
+        self._flush_restored()
         return idx
 
     def _on_agent_close(self, idx: int):
@@ -279,12 +349,25 @@ class Head:
         if kill_workers:
             for w in list(node.workers.values()):
                 self._kill_worker_process(w)
-        # objects on this node are lost
+        # objects on this node are lost: answer any blocked locates with the
+        # LOST sentinel (-2) and remember the ids so later locates fail fast
+        # — owners react by re-executing the creating task (lineage
+        # reconstruction; reference: object_recovery_manager.h:41)
+        lost_waiters: List[Tuple[P.Connection, int]] = []
         with self._lock:
             lost = [oid for oid, loc in self.objects.items()
                     if loc.node_idx == idx and not loc.spilled_path]
             for oid in lost:
+                lost_waiters.extend(self.objects[oid].waiters)
                 del self.objects[oid]
+                self.lost_objects[oid] = None
+            while len(self.lost_objects) > 65536:
+                self.lost_objects.pop(next(iter(self.lost_objects)))
+        for wconn, wrid in lost_waiters:
+            try:
+                wconn.reply(wrid, -2, 0, "", msg_type=P.OBJECT_LOCATE_REPLY)
+            except P.ConnectionLost:
+                pass
         if node.store is not None:
             node.store.close()
         if node.agent_conn is not None:
@@ -655,6 +738,10 @@ class Head:
                         f"actor name '{info.name}' already taken"))
                     return
                 self.named_actors[info.name] = spec.actor_id
+        if info.name and self._persist is not None:
+            # named == detached: survives head restart (reference: GCS
+            # actor table; detached actors rescheduled after failover)
+            self._enqueue_wal(("actor", spec_bytes))
         self._schedule_actor(info)
         conn.reply(rid, True, msg_type=P.CREATE_ACTOR_REPLY)
 
@@ -779,6 +866,15 @@ class Head:
         if info.name and self.named_actors.get(info.name) == info.actor_id:
             del self.named_actors[info.name]
             self.kv.get("named_actor", {}).pop(info.name, None)
+            if self._persist is not None:
+                # callers hold self._lock — defer the file write (WAL
+                # append can compact = read+rewrite+fsync the whole log).
+                # The kv_del keeps the restored KV mirror consistent: a
+                # restart must not resurrect a handle to a dead actor.
+                self._wal_backlog.append(
+                    ("actor_gone", info.actor_id.binary()))
+                self._wal_backlog.append(
+                    ("kv_del", "named_actor", info.name))
 
     def _h_get_actor(self, conn, rid, actor_id_bin_or_name):
         with self._lock:
@@ -852,6 +948,8 @@ class Head:
                         for i in self.scheduler.schedulable_nodes())
                     for b in spec.bundles)
                 if not feasible:
+                    # not persisted: the client sees an error, so a restart
+                    # must not resurrect a phantom group
                     conn.reply_error(rid, RuntimeError(
                         "placement group infeasible: no node can ever fit "
                         "some bundle"))
@@ -860,10 +958,13 @@ class Head:
                 info = PgInfo(spec=spec)
                 self.pgs[spec.pg_id] = info
                 self._pending_pg.append(spec.pg_id)
-                conn.reply(rid, "PENDING", msg_type=P.CREATE_PG_REPLY)
-                return
-            self._commit_pg(spec, placement)
-        conn.reply(rid, "CREATED", msg_type=P.CREATE_PG_REPLY)
+                reply = ("PENDING",)
+            else:
+                self._commit_pg(spec, placement)
+                reply = ("CREATED",)
+        if self._persist is not None:
+            self._enqueue_wal(("pg", spec_bytes))
+        conn.reply(rid, *reply, msg_type=P.CREATE_PG_REPLY)
 
     def _commit_pg(self, spec: PlacementGroupSpec, placement: List[int]):
         """Reserve bundle resources on nodes (2PC prepare+commit collapses to
@@ -898,6 +999,8 @@ class Head:
 
     def _h_remove_pg(self, conn, rid, pg_id_bin):
         pg_id = PlacementGroupID(pg_id_bin)
+        if self._persist is not None:
+            self._enqueue_wal(("pg_gone", pg_id_bin))
         with self._lock:
             self.kv.setdefault("pg_state", {})[pg_id.hex()] = b"REMOVED"
             info = self.pgs.pop(pg_id, None)
@@ -966,6 +1069,8 @@ class Head:
             else:
                 table[key] = value
                 added = True
+        if added and self._persist is not None:
+            self._enqueue_wal(("kv_put", ns, key, value))
         if rid > 0:
             conn.reply(rid, added)
 
@@ -976,6 +1081,8 @@ class Head:
     def _h_kv_del(self, conn, rid, ns, key):
         with self._lock:
             existed = self.kv.get(ns, {}).pop(key, None) is not None
+        if existed and self._persist is not None:
+            self._enqueue_wal(("kv_del", ns, key))
         if rid > 0:
             conn.reply(rid, existed)
 
@@ -1012,6 +1119,7 @@ class Head:
     def _h_object_sealed(self, conn, rid, oid_bin, node_idx, size, owner):
         oid = ObjectID(oid_bin)
         with self._lock:
+            self.lost_objects.pop(oid, None)  # a recovered object is found again
             loc = self.objects.setdefault(oid, _ObjLoc())
             loc.node_idx = node_idx
             loc.size = size
@@ -1031,17 +1139,57 @@ class Head:
                 conn.reply(rid, loc.node_idx, loc.size, loc.spilled_path,
                            msg_type=P.OBJECT_LOCATE_REPLY)
                 return
+            if oid in self.lost_objects:
+                # sealed once, then its node died: fail fast so the owner
+                # can reconstruct instead of blocking forever
+                conn.reply(rid, -2, 0, "", msg_type=P.OBJECT_LOCATE_REPLY)
+                return
             if not block:
                 conn.reply(rid, -1, 0, "", msg_type=P.OBJECT_LOCATE_REPLY)
                 return
             loc = self.objects.setdefault(oid, _ObjLoc())
             loc.waiters.append((conn, rid))
 
+    def _h_seal_aborted(self, conn, rid, oid_bins):
+        """The creating task failed permanently: these returns will never
+        seal. Mark them LOST and answer blocked locates with -2 so
+        borrowers surface ObjectLostError instead of hanging (the owner
+        holds the actual error in its in-process store)."""
+        waiters: List[Tuple[P.Connection, int]] = []
+        with self._lock:
+            for ob in oid_bins:
+                oid = ObjectID(ob)
+                loc = self.objects.get(oid)
+                if loc is not None and (loc.node_idx >= 0 or
+                                        loc.spilled_path):
+                    continue  # a real copy exists (e.g. partial returns)
+                if loc is not None:
+                    waiters.extend(loc.waiters)
+                    loc.waiters.clear()
+                    del self.objects[oid]
+                self.lost_objects[oid] = None
+        for wconn, wrid in waiters:
+            try:
+                wconn.reply(wrid, -2, 0, "", msg_type=P.OBJECT_LOCATE_REPLY)
+            except P.ConnectionLost:
+                pass
+
+    def _h_object_recovering(self, conn, rid, oid_bins):
+        """An owner is re-executing the creating task for these lost
+        objects: clear the LOST marker so consumers' blocking locates queue
+        as waiters for the re-seal rather than failing fast."""
+        with self._lock:
+            for ob in oid_bins:
+                self.lost_objects.pop(ObjectID(ob), None)
+        if rid > 0:
+            conn.reply(rid, True)
+
     def _h_object_free(self, conn, rid, oid_bins):
         for ob in oid_bins:
             oid = ObjectID(ob)
             with self._lock:
                 loc = self.objects.pop(oid, None)
+                self.lost_objects.pop(oid, None)
             if loc is None:
                 continue
             if loc.spilled_path:
@@ -1232,6 +1380,68 @@ class Head:
 
     # ------------------------------------------------------------ cluster info
 
+    def _h_task_events(self, conn, rid, batch, dropped):
+        """Workers' task-state transitions land in a bounded ring buffer
+        (reference: GcsTaskManager; src/ray/gcs/gcs_server/gcs_task_manager.h)."""
+        with self._lock:
+            self.task_events.extend(batch)
+            self.task_events_dropped += dropped
+
+    def _h_state_query(self, conn, rid, kind, limit):
+        """Observability state API (reference: python/ray/util/state/api.py
+        backed by the GCS aggregator endpoints)."""
+        with self._lock:
+            if kind == "nodes":
+                rows = [{
+                    "node_idx": n.idx, "alive": n.alive,
+                    "is_remote": n.is_remote, "node_ip": n.node_ip,
+                    "resources_total": n.resources.total.to_dict(),
+                    "resources_available": n.resources.available.to_dict(),
+                } for n in self.nodes.values()]
+            elif kind == "workers":
+                rows = [{
+                    "worker_id": w.worker_id, "node_idx": n.idx,
+                    "pid": w.pid, "state": w.state,
+                    "actor_id": w.actor_id.hex() if w.actor_id else None,
+                } for n in self.nodes.values()
+                    for w in n.workers.values()]
+            elif kind == "actors":
+                rows = [{
+                    "actor_id": a.actor_id.hex(), "state": a.state,
+                    "name": a.name, "class_name": a.spec.class_name,
+                    "worker_id": a.worker_id, "restarts": a.restarts_used,
+                    "death_cause": a.death_cause,
+                } for a in self.actors.values()]
+            elif kind == "placement_groups":
+                rows = [{
+                    "pg_id": pid.hex(), "state": info.state,
+                    "strategy": info.spec.strategy,
+                    "bundles": [b.resources for b in info.spec.bundles],
+                    "placement": list(info.placement),
+                } for pid, info in self.pgs.items()]
+            elif kind == "objects":
+                rows = [{
+                    "object_id": oid.hex(), "node_idx": loc.node_idx,
+                    "size": loc.size, "owner": loc.owner,
+                    "spilled": bool(loc.spilled_path),
+                } for oid, loc in self.objects.items()
+                    if loc.node_idx >= 0 or loc.spilled_path]
+            elif kind == "tasks":
+                # newest state wins per task id; newest tasks first
+                latest: Dict[str, dict] = {}
+                for (tid, name, state, wid, nidx, ts, err) in \
+                        self.task_events:
+                    latest[tid] = {
+                        "task_id": tid, "name": name, "state": state,
+                        "worker_id": wid, "node_idx": nidx,
+                        "ts": ts, "error": err,
+                    }
+                rows = list(latest.values())[::-1]
+            else:
+                conn.reply_error(rid, ValueError(f"unknown kind {kind!r}"))
+                return
+        conn.reply(rid, rows[:limit])
+
     def _h_node_info(self, conn, rid):
         with self._lock:
             infos = [{
@@ -1277,6 +1487,7 @@ class Head:
         P.OBJECT_SEALED: _h_object_sealed,
         P.OBJECT_LOCATE: _h_object_locate,
         P.OBJECT_FREE: _h_object_free,
+        P.OBJECT_RECOVERING: _h_object_recovering,
         P.OBJECT_TRANSFER: _h_object_transfer,
         P.NODE_INFO: _h_node_info,
         P.DRAIN_NODE: _h_drain_node,
@@ -1288,7 +1499,12 @@ class Head:
             self._forward_to_worker(owner, P.BORROW_ADD, oid, borrower),
         P.BORROW_REMOVE: lambda self, conn, rid, oid, owner, borrower:
             self._forward_to_worker(owner, P.BORROW_REMOVE, oid, borrower),
+        P.RECOVER_OBJECT: lambda self, conn, rid, oid, owner:
+            self._forward_to_worker(owner, P.RECOVER_OBJECT, oid),
         P.REGISTER_NODE: _h_register_node,
+        P.TASK_EVENTS: _h_task_events,
+        P.STATE_QUERY: _h_state_query,
+        P.SEAL_ABORTED: _h_seal_aborted,
     }
 
     def _forward_to_worker(self, worker_id: str, mt: int, *fields):
@@ -1307,6 +1523,22 @@ class Head:
 
     # ------------------------------------------------------------ lifecycle
 
+    def _enqueue_wal(self, rec: tuple):
+        """Queue a durable record; the housekeeping thread does the file
+        IO (append can trigger compaction = read+rewrite+fsync of the
+        whole log — never on the RPC dispatch thread). Trade-off: a hard
+        head crash can lose the last <0.25s of records; shutdown drains."""
+        with self._lock:
+            self._wal_backlog.append(rec)
+
+    def _drain_wal_backlog(self):
+        if self._persist is None:
+            return
+        with self._lock:
+            batch, self._wal_backlog = self._wal_backlog, []
+        for rec in batch:
+            self._persist.append(rec)
+
     def _housekeeping_loop(self):
         while not self._shutdown:
             time.sleep(0.25)
@@ -1321,6 +1553,7 @@ class Head:
     def periodic(self):
         """Housekeeping: PG retries, lease grants, idle worker reaping.
         Driven by the head's own keeper thread (and callable from tests)."""
+        self._drain_wal_backlog()
         self._retry_pending_pgs()
         self._try_fulfill_pending()
         cfg = get_config()
@@ -1340,6 +1573,8 @@ class Head:
 
     def shutdown(self):
         self._shutdown = True
+        if self._log_monitor is not None:
+            self._log_monitor.stop()
         with self._lock:
             workers = [w for n in self.nodes.values()
                        for w in n.workers.values()]
@@ -1371,6 +1606,9 @@ class Head:
             except Exception:
                 pass
         self.nodes.clear()
+        if self._persist is not None:
+            self._drain_wal_backlog()
+            self._persist.close()
 
 
 def env_jax_platform(node: NodeState) -> str:

@@ -80,6 +80,22 @@ class NodeAgent:
                                self.node_ip, self.session_dir,
                                self.transfer_server.addr, timeout=30)
         self.node_idx, self.session_name = reply[0], reply[1]
+        # Tail THIS host's worker logs and publish them through the head's
+        # "logs" channel so remote tasks' prints reach the driver too
+        # (reference: one log_monitor per node, log_monitor.py:103).
+        from .log_monitor import LogMonitor
+        from .serialization import dumps as _dumps
+
+        def _forward(ch, data):
+            data = dict(data)
+            data["source"] = f"node{self.node_idx}-" + data.get("source", "")
+            try:
+                self.head.send(P.PUBLISH, ch, _dumps(data))
+            except P.ConnectionLost:
+                pass
+
+        self.log_monitor = LogMonitor(self.session_dir, _forward)
+        self.log_monitor.start()
 
     def _read_object(self, oid: ObjectID):
         got = self.store.get(oid)
@@ -201,6 +217,8 @@ class NodeAgent:
 
     def shutdown(self):
         self._shutdown.set()
+        if getattr(self, "log_monitor", None) is not None:
+            self.log_monitor.stop()
         with self._lock:
             procs = list(self.workers.values())
             self.workers.clear()

@@ -92,6 +92,13 @@ OBJ_PULL_CHUNK = 58     # server->puller header: (oid_bin, offset, size);
 OBJ_PULL_DONE = 59      # server->puller: (oid_bin)
 RAW_FRAME = 60          # synthetic msg type for raw frames: (RAW_FRAME, 0, bytes)
 OBJ_PULL_META = 61      # server->puller: (oid_bin, size|-1, meta_bytes)
+OBJECT_RECOVERING = 62  # owner->head: ([oid_bins],) lineage re-execution began
+RECOVER_OBJECT = 63     # borrower->head->owner: (oid_bin, owner_hex) please
+                        # reconstruct — the lineage lives with the owner
+STATE_QUERY = 64        # (kind, limit) -> ([rows],) observability state API
+SEAL_ABORTED = 65       # owner->head: ([oid_bins],) the creating task failed
+                        # permanently — these ids will never seal; fail any
+                        # blocked locate waiters instead of hanging them
 
 # High bit of the length prefix marks a RAW frame: the payload is
 # unpickled bytes (bulk data follows its pickled header message). Sending

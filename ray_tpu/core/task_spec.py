@@ -58,6 +58,7 @@ class TaskSpec:
     owner: str = ""                        # worker id hex of the submitter
     # actor fields
     actor_id: Optional[ActorID] = None
+    class_name: str = ""                   # actor class, for observability
     method_name: str = ""
     seqno: int = 0
     max_restarts: int = 0

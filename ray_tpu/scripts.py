@@ -1,0 +1,205 @@
+"""`python -m ray_tpu` — the cluster CLI.
+
+Parity with the reference's `ray` CLI (python/ray/scripts/scripts.py):
+``start --head`` / ``start --address`` / ``status`` / ``stop`` /
+``list <entity>`` / ``summary tasks``. The head command runs a persistent
+GCS-lite process other hosts join over TCP (node agents via
+``start --address``, drivers via ``init(address=...)``); its coordinates
+are written to ``--address-file`` (default ``/tmp/ray_tpu/head_address``)
+so the sibling commands find it without flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+DEFAULT_ADDRESS_FILE = "/tmp/ray_tpu/head_address"
+
+
+def _write_address_file(path: str, payload: dict):
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f)
+
+
+def _read_address(args) -> str:
+    if getattr(args, "address", None):
+        return args.address
+    try:
+        with open(args.address_file) as f:
+            return json.load(f)["address"]
+    except (OSError, KeyError, ValueError):
+        sys.exit(f"no --address given and {args.address_file} not found; "
+                 f"is a head running? (start one: python -m ray_tpu start "
+                 f"--head)")
+
+
+def cmd_start(args):
+    if args.head:
+        return _start_head(args)
+    if not args.address:
+        sys.exit("start needs --head or --address tcp:IP:PORT")
+    # join an existing head as a node agent (reference: `ray start
+    # --address`, raylet registration)
+    from ray_tpu.core.node_agent import main as agent_main
+
+    agent_args = ["--address", args.address,
+                  "--num-cpus", str(args.num_cpus or os.cpu_count() or 1)]
+    if args.num_tpus is not None:
+        agent_args += ["--num-tpus", str(args.num_tpus)]
+    return agent_main(agent_args)
+
+
+def _start_head(args):
+    import uuid
+
+    from ray_tpu.core.head import Head
+
+    session_name = uuid.uuid4().hex[:10]
+    session_dir = args.session_dir or \
+        f"/tmp/ray_tpu/session_{session_name}"
+    os.makedirs(session_dir, exist_ok=True)
+    head = Head(session_dir, session_name)
+    head.add_node(num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+    head.start()
+    tcp = head.enable_tcp(port=args.port or 0)
+    payload = {"address": head.addr, "tcp_address": tcp,
+               "session_dir": session_dir, "pid": os.getpid()}
+    _write_address_file(args.address_file, payload)
+    print(f"head started\n  local driver address: {head.addr}\n"
+          f"  cluster join address: {tcp}\n  session dir: {session_dir}\n"
+          f"join from another host:\n  python -m ray_tpu start "
+          f"--address {tcp}\nattach a driver:\n  ray_tpu.init("
+          f"address={head.addr!r})", flush=True)
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    while not stop:
+        time.sleep(0.2)
+    head.shutdown()
+    return 0
+
+
+def cmd_stop(args):
+    try:
+        with open(args.address_file) as f:
+            pid = json.load(f)["pid"]
+    except (OSError, KeyError, ValueError):
+        sys.exit(f"{args.address_file} not found; nothing to stop")
+    try:
+        os.kill(pid, signal.SIGTERM)
+        print(f"sent SIGTERM to head (pid {pid})")
+    except ProcessLookupError:
+        print(f"head (pid {pid}) already gone")
+    try:
+        os.unlink(args.address_file)
+    except OSError:
+        pass
+    return 0
+
+
+def _attached(args):
+    import ray_tpu
+
+    ray_tpu.init(address=_read_address(args), log_to_driver=False)
+    return ray_tpu
+
+
+def cmd_status(args):
+    rt = _attached(args)
+    nodes = rt.nodes()
+    total = rt.cluster_resources()
+    avail = rt.available_resources()
+    print(f"nodes: {len(nodes)}")
+    for n in nodes:
+        state = "ALIVE" if n.get("alive", True) else "DEAD"
+        print(f"  node {n['node_idx']}: {state}  "
+              f"{n.get('resources_total', {})}  "
+              f"workers={n.get('num_workers', 0)}")
+    print("resources (available / total):")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0):g} / {total[k]:g}")
+    return 0
+
+
+def cmd_list(args):
+    from ray_tpu import state as state_api
+
+    fn = {
+        "nodes": state_api.list_nodes,
+        "workers": state_api.list_workers,
+        "actors": state_api.list_actors,
+        "tasks": state_api.list_tasks,
+        "objects": state_api.list_objects,
+        "placement-groups": state_api.list_placement_groups,
+    }[args.entity]
+    _attached(args)
+    rows = fn(limit=args.limit)
+    print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
+def cmd_summary(args):
+    from ray_tpu import state as state_api
+
+    _attached(args)
+    fn = {"tasks": state_api.summarize_tasks,
+          "actors": state_api.summarize_actors,
+          "objects": state_api.summarize_objects}[args.entity]
+    print(json.dumps(fn(), indent=2, default=str))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ray_tpu",
+                                description="ray_tpu cluster CLI")
+    p.add_argument("--address-file", default=DEFAULT_ADDRESS_FILE,
+                   help="where the head's coordinates are written/read")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head or join as a node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", help="tcp:IP:PORT of the head to join")
+    sp.add_argument("--port", type=int, help="TCP port for the head")
+    sp.add_argument("--num-cpus", type=int, default=None)
+    sp.add_argument("--num-tpus", type=int, default=None)
+    sp.add_argument("--session-dir", default="",
+                    help="reuse a previous session dir to restore head "
+                         "state from its WAL")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the head started by `start`")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster nodes + resources")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster entities")
+    sp.add_argument("entity", choices=["nodes", "workers", "actors",
+                                       "tasks", "objects",
+                                       "placement-groups"])
+    sp.add_argument("--address")
+    sp.add_argument("--limit", type=int, default=100)
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="aggregate task/actor/object stats")
+    sp.add_argument("entity", choices=["tasks", "actors", "objects"])
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_summary)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args) or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,103 @@
+"""Cluster state API: list/summarize live entities from the head tables.
+
+The reference's observability surface `ray.util.state`
+(python/ray/util/state/api.py:782 list_tasks / list_actors / list_objects /
+list_nodes / list_workers / list_placement_groups, :1009 summarize) queries
+the GCS + per-node aggregators over HTTP. Here every table already lives in
+the head (GCS-lite), so the API is one STATE_QUERY RPC; task rows come from
+the task-event ring buffer workers flush to the head
+(src/ray/core_worker/task_event_buffer.h analog in core/events.py).
+
+Each ``list_*`` returns a list of plain dicts (the reference returns typed
+rows convertible to dicts); ``filters`` are ``(key, "=", value)`` tuples
+matched client-side.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .core import protocol as P
+from .core.context import get_context
+
+_DEFAULT_LIMIT = 100
+
+
+def _query(kind: str, limit: int) -> List[Dict[str, Any]]:
+    ctx = get_context()
+    (rows,) = ctx.head.call(P.STATE_QUERY, kind, limit, timeout=30)
+    return rows
+
+
+def _apply_filters(rows: List[Dict[str, Any]],
+                   filters: Optional[Sequence[Tuple[str, str, Any]]]
+                   ) -> List[Dict[str, Any]]:
+    if not filters:
+        return rows
+    for key, op, value in filters:
+        if op not in ("=", "=="):
+            raise ValueError(f"unsupported filter op {op!r} (only '=')")
+        rows = [r for r in rows if str(r.get(key)) == str(value)]
+    return rows
+
+
+def list_nodes(filters=None, limit: int = _DEFAULT_LIMIT):
+    """Ref parity: ray.util.state.list_nodes (util/state/api.py:880)."""
+    return _apply_filters(_query("nodes", limit), filters)
+
+
+def list_workers(filters=None, limit: int = _DEFAULT_LIMIT):
+    """Ref parity: ray.util.state.list_workers."""
+    return _apply_filters(_query("workers", limit), filters)
+
+
+def list_actors(filters=None, limit: int = _DEFAULT_LIMIT):
+    """Ref parity: ray.util.state.list_actors (util/state/api.py:782)."""
+    return _apply_filters(_query("actors", limit), filters)
+
+
+def list_placement_groups(filters=None, limit: int = _DEFAULT_LIMIT):
+    """Ref parity: ray.util.state.list_placement_groups."""
+    return _apply_filters(_query("placement_groups", limit), filters)
+
+
+def list_objects(filters=None, limit: int = _DEFAULT_LIMIT):
+    """Ref parity: ray.util.state.list_objects (head object directory:
+    plasma-resident + spilled objects; in-process inline values are not
+    cluster-visible, matching the reference's plasma-only view)."""
+    return _apply_filters(_query("objects", limit), filters)
+
+
+def list_tasks(filters=None, limit: int = _DEFAULT_LIMIT):
+    """Ref parity: ray.util.state.list_tasks — latest state per task id,
+    newest first, from the head's task-event ring buffer."""
+    return _apply_filters(_query("tasks", limit), filters)
+
+
+def summarize_tasks(limit: int = 10_000) -> Dict[str, Any]:
+    """Ref parity: ray.util.state.summarize_tasks (api.py:1009): count of
+    tasks by (name, state)."""
+    rows = list_tasks(limit=limit)
+    by_func: Dict[str, Counter] = {}
+    for r in rows:
+        by_func.setdefault(r["name"], Counter())[r["state"]] += 1
+    return {
+        "total": len(rows),
+        "by_func_name": {k: dict(v) for k, v in sorted(by_func.items())},
+    }
+
+
+def summarize_actors(limit: int = 10_000) -> Dict[str, Any]:
+    rows = list_actors(limit=limit)
+    states = Counter(r["state"] for r in rows)
+    return {"total": len(rows), "by_state": dict(states)}
+
+
+def summarize_objects(limit: int = 10_000) -> Dict[str, Any]:
+    rows = list_objects(limit=limit)
+    return {
+        "total": len(rows),
+        "total_size_bytes": sum(r.get("size", 0) for r in rows),
+        "spilled": sum(1 for r in rows if r.get("spilled")),
+    }

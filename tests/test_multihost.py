@@ -162,3 +162,31 @@ def test_p2p_transfer_bypasses_head_memory(tcp_cluster):
     arr = ray_tpu.get(ref, timeout=120)
     assert arr.shape == (500_000,)
     assert head.relay_bytes == 0
+
+
+def test_remote_worker_logs_mirrored_to_driver(tcp_cluster, capfd):
+    """print() in a task on a REMOTE node reaches the driver: the node
+    agent's log monitor forwards lines through the head's "logs" channel
+    (reference: per-node log_monitor.py -> GCS pubsub -> driver)."""
+    import time
+
+    cluster, handles = tcp_cluster
+    remote = cluster.add_remote_node(num_cpus=1)
+    handles.append(remote)
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        remote.node_idx))
+    def chatty():
+        print("hello-from-remote-node-abc", flush=True)
+        return 0
+
+    ray_tpu.get(chatty.remote(), timeout=120)
+    deadline = time.monotonic() + 15
+    seen = ""
+    while time.monotonic() < deadline:
+        seen += capfd.readouterr().err
+        if "hello-from-remote-node-abc" in seen:
+            break
+        time.sleep(0.2)
+    assert "hello-from-remote-node-abc" in seen
+    assert f"(node{remote.node_idx}-worker-" in seen
