@@ -1,0 +1,14 @@
+"""General DAG IR: build lazy task/actor graphs, execute them later.
+
+Ref parity: ray.dag (python/ray/dag/dag_node.py:23 DAGNode,
+function_node.py, class_node.py, input_node.py): ``fn.bind(...)`` builds a
+node instead of executing; ``dag.execute(input)`` walks the graph
+submitting tasks bottom-up. Serve's deployment graphs and Workflows both
+compile through this IR (as in the reference).
+"""
+
+from ray_tpu.dag.dag_node import (ClassMethodNode, ClassNode, DAGNode,
+                                  FunctionNode, InputNode)
+
+__all__ = ["DAGNode", "FunctionNode", "ClassNode", "ClassMethodNode",
+           "InputNode"]

@@ -13,16 +13,20 @@ Algorithms are Tune Trainables, so ``Tuner(PPO, param_space=...)`` works.
 """
 
 from .algorithm import Algorithm, AlgorithmConfig
+from .dqn import DQN, DQNConfig, DQNLearner
 from .env import CartPole, Env, VectorEnv, make_env, register_env
 from .impala import IMPALA, IMPALAConfig
 from .learner import ImpalaLearner, LearnerGroup, PPOLearner, vtrace
 from .policy import JaxPolicy
+from .replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 from .ppo import PPO, PPOConfig
 from .rollout_worker import RolloutWorker
 from .sample_batch import SampleBatch, compute_gae, concat_samples
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
+    "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer",
+    "PrioritizedReplayBuffer",
     "IMPALAConfig", "Env", "CartPole", "VectorEnv", "make_env",
     "register_env", "JaxPolicy", "RolloutWorker", "SampleBatch",
     "concat_samples", "compute_gae", "PPOLearner", "ImpalaLearner",

@@ -1,22 +1,27 @@
 """IMPALA: asynchronous off-policy training with V-trace correction.
 
-Ref analog: rllib/algorithms/impala/impala.py:552 (async sample queue,
-:685 training_step). Re-designed: each rollout worker keeps one in-flight
-``sample_time_major`` future; as futures complete, the learner consumes
-them immediately (off-policy — the batch may be a few updates stale, which
-V-trace corrects) and the worker is restarted with fresh weights. The
-object plane carries the sample batches, exercising worker->learner
-transfer exactly like the reference's aggregation path.
+Ref analog: rllib/algorithms/impala/impala.py:552 (async sample queue +
+aggregation, :685 training_step). Pipelined: every rollout worker keeps
+``num_inflight_per_worker`` sample futures outstanding (rollout latency is
+hidden behind the learner), and ``num_aggregation_batches`` completed
+rollouts are coalesced into one [T, N_total] batch per learner update —
+the reference's aggregation actors exist to feed the learner large
+batches the same way; here the concat is driver-side numpy and the update
+is one XLA call, so the accelerator sees few large programs instead of
+many small ones. Batches may be several updates stale; V-trace corrects.
 """
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, List
+
+import numpy as np
 
 import ray_tpu
 
 from .algorithm import Algorithm, AlgorithmConfig
 from .learner import ImpalaLearner
+from .sample_batch import SampleBatch
 
 
 class IMPALAConfig(AlgorithmConfig):
@@ -27,6 +32,19 @@ class IMPALAConfig(AlgorithmConfig):
         self.clip_rho = 1.0
         self.clip_c = 1.0
         self.max_updates_per_step = 8
+        # pipeline depth: outstanding rollouts per worker
+        self.num_inflight_per_worker = 2
+        # rollouts merged per learner update (fixed -> stable XLA shapes)
+        self.num_aggregation_batches = 2
+
+
+def _concat_time_major(batches: List[SampleBatch]) -> SampleBatch:
+    """Merge [T, Ni] rollouts along the env axis -> [T, sum(Ni)]."""
+    out = {}
+    for k in batches[0]:
+        axis = 0 if k == "bootstrap_obs" else 1
+        out[k] = np.concatenate([b[k] for b in batches], axis=axis)
+    return SampleBatch(out)
 
 
 class IMPALA(Algorithm):
@@ -45,31 +63,38 @@ class IMPALA(Algorithm):
 
     def setup(self, config):
         super().setup(config)
-        # one in-flight rollout per worker, started immediately
-        self._inflight: Dict = {
-            w.sample_time_major.remote(): w for w in self.workers}
+        cfg = self.algo_config
+        # prime the pipeline: K outstanding rollouts per worker
+        self._inflight: Dict = {}
+        for w in self.workers:
+            for _ in range(cfg.num_inflight_per_worker):
+                self._inflight[w.sample_time_major.remote()] = w
 
     def training_step(self) -> dict:
         cfg = self.algo_config
         metrics: dict = {}
         steps = 0
         updates = 0
+        agg = max(1, min(cfg.num_aggregation_batches, len(self._inflight)))
         while updates < cfg.max_updates_per_step:
-            done, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+            done, _ = ray_tpu.wait(list(self._inflight), num_returns=agg,
                                    timeout=600)
-            ref = done[0]
-            worker = self._inflight.pop(ref)
-            batch = ray_tpu.get(ref, timeout=600)
-            # learner consumes the (possibly stale) batch; V-trace corrects
-            metrics = self.learners.local.update(batch)
+            batches = ray_tpu.get(list(done), timeout=600)
+            workers_done = [self._inflight.pop(r) for r in done]
+            merged = _concat_time_major(batches)
+            # one large update instead of `agg` small ones
+            metrics = self.learners.local.update(merged)
             updates += 1
-            steps += batch[  # time-major [T, N]
-                "actions"].size
-            # restart the worker with fresh weights
-            worker.set_weights.remote(
-                ray_tpu.put(self.learners.get_weights()))
-            self._inflight[worker.sample_time_major.remote()] = worker
+            steps += merged["actions"].size
+            # refresh weights once per update round (once per distinct
+            # worker), then refill the pipeline slots
+            w_ref = ray_tpu.put(self.learners.get_weights())
+            for w in dict((id(x), x) for x in workers_done).values():
+                w.set_weights.remote(w_ref)
+            for w in workers_done:
+                self._inflight[w.sample_time_major.remote()] = w
         self._num_env_steps += steps
         metrics["env_steps_this_iter"] = steps
         metrics["updates_this_iter"] = updates
+        metrics["aggregation"] = agg
         return metrics

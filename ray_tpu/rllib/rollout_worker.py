@@ -30,6 +30,7 @@ class RolloutWorker:
         self.gamma = gamma
         self.lam = lam
         self.worker_idx = worker_idx
+        self._eps_seq = 0  # decorrelates sample_transitions RNG per call
 
     def sample(self) -> SampleBatch:
         """Collect one rollout of [T, N] and flatten to [T*N] with GAE."""
@@ -96,6 +97,46 @@ class RolloutWorker:
             SB.DONES: done_buf,
             SB.ACTION_LOGP: logp_buf,
             "bootstrap_obs": obs.copy(),
+        })
+
+    def sample_transitions(self, epsilon: float = 0.0) -> SampleBatch:
+        """(s, a, r, s', done) tuples with epsilon-greedy exploration —
+        the off-policy (DQN) collection path (ref: rollout_worker sample
+        with EpsilonGreedy exploration, utils/exploration/epsilon_greedy
+        .py). The policy's logits head is read as Q-values."""
+        T, N = self.rollout_len, self.vec.num_envs
+        D = self.vec.observation_dim
+        obs_buf = np.zeros((T, N, D), np.float32)
+        next_buf = np.zeros((T, N, D), np.float32)
+        act_buf = np.zeros((T, N), np.int64)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.bool_)
+        rng = np.random.default_rng(
+            int(epsilon * 1e6) + self.worker_idx * 7919 + self._eps_seq)
+        self._eps_seq += 1
+
+        obs = self.vec.obs
+        for t in range(T):
+            greedy, _ = self.policy._greedy(
+                self.policy.params, np.asarray(obs, np.float32))
+            actions = np.array(greedy)  # writable copy (jax views are RO)
+            explore = rng.random(N) < epsilon
+            actions[explore] = rng.integers(
+                0, self.vec.num_actions, size=int(explore.sum()))
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            obs, rewards, dones = self.vec.step(actions)
+            next_buf[t] = obs
+            rew_buf[t] = rewards
+            done_buf[t] = dones
+
+        flat = lambda x: x.reshape((T * N,) + x.shape[2:])  # noqa: E731
+        return SampleBatch({
+            SB.OBS: flat(obs_buf),
+            SB.ACTIONS: flat(act_buf),
+            SB.REWARDS: flat(rew_buf),
+            SB.DONES: flat(done_buf),
+            SB.NEXT_OBS: flat(next_buf),
         })
 
     # ---- weight sync / metrics ----

@@ -20,6 +20,7 @@ from .search import (
     BasicVariantGenerator,
     RandomSearch,
     Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -44,7 +45,7 @@ __all__ = [
     "TrialScheduler", "FIFOScheduler", "AsyncHyperBandScheduler",
     "ASHAScheduler", "HyperBandScheduler", "MedianStoppingRule",
     "PopulationBasedTraining",
-    "Searcher", "BasicVariantGenerator", "RandomSearch",
+    "Searcher", "BasicVariantGenerator", "RandomSearch", "TPESearcher",
     "choice", "uniform", "loguniform", "quniform", "randint", "qrandint",
     "grid_search", "sample_from",
 ]

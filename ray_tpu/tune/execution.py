@@ -43,6 +43,7 @@ class TuneController:
                  storage_path: Optional[str] = None,
                  experiment_name: str = "experiment",
                  time_budget_s: Optional[float] = None,
+                 num_samples: int = 0,
                  trial_executor_kwargs=None):
         self._cls = trainable_cls
         self._searcher = searcher
@@ -59,6 +60,9 @@ class TuneController:
         self.trials: List[Trial] = []
         self._runners: Dict[str, _TrialRunner] = {}
         self._max_concurrent = max_concurrent or self._default_concurrency()
+        # bounds suggestion-based searchers (TPE etc.) that never return
+        # None on their own; 0 = unbounded (pre-expanded searchers exhaust)
+        self._num_samples = num_samples
         self._exhausted = False
         self._storage = storage_path
         self._name = experiment_name
@@ -140,6 +144,9 @@ class TuneController:
                 self._start_trial(trial)
                 continue
             if self._exhausted:
+                break
+            if self._num_samples and len(self.trials) >= self._num_samples:
+                self._exhausted = True
                 break
             tid = f"t{len(self.trials):05d}"
             cfg = self._searcher.suggest(tid)

@@ -224,3 +224,148 @@ class RandomSearch(Searcher):
             _set_path(cfg, p, v.sample(self._rng)
                       if isinstance(v, Domain) else v)
         return cfg
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (Bergstra et al., NeurIPS 2011).
+
+    Ref analog: the reference ships Bayesian-class searchers as wrappers
+    (tune/search/hyperopt/hyperopt_search.py wraps hyperopt's TPE,
+    tune/search/bayesopt, tune/search/optuna). Implemented natively here
+    (no external optimizer dependency): completed trials are split into a
+    good set (top ``gamma`` quantile by the objective) and a bad set; each
+    candidate is drawn from the good set's Parzen density l(x) and ranked
+    by the acquisition log l(x) - log g(x), factorized per axis.
+    """
+
+    def __init__(self, space: Dict[str, Any], *, metric: str = "reward",
+                 mode: str = "max", n_initial_points: int = 10,
+                 gamma: float = 0.25, n_candidates: int = 24,
+                 seed: Optional[int] = None):
+        super().__init__(metric=metric, mode=mode)
+        self._leaves = list(_split_space(space))
+        for p, v in self._leaves:
+            if isinstance(v, SampleFrom):
+                raise ValueError("TPESearcher does not support sample_from")
+        self._rng = random.Random(seed)
+        self._n_initial = n_initial_points
+        self._gamma = gamma
+        self._n_candidates = n_candidates
+        self._live: Dict[str, Dict[str, Any]] = {}   # trial_id -> config
+        self._observed: List[tuple] = []             # (config, score)
+
+    # ------------------------------------------------------- observations
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        cfg = self._live.pop(trial_id, None)
+        if cfg is None or error or not result or \
+                self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._observed.append((cfg, score))
+
+    # --------------------------------------------------------- suggesting
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._observed) < self._n_initial:
+            cfg = self._random_config()
+        else:
+            cfg = self._tpe_config()
+        self._live[trial_id] = cfg
+        return cfg
+
+    def _random_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+        for p, v in self._leaves:
+            if isinstance(v, GridSearch):
+                v = Categorical(v.values)
+            _set_path(cfg, p, v.sample(self._rng)
+                      if isinstance(v, Domain) else v)
+        return cfg
+
+    def _tpe_config(self) -> Dict[str, Any]:
+        ranked = sorted(self._observed, key=lambda cv: cv[1], reverse=True)
+        n_good = max(1, int(self._gamma * len(ranked)))
+        good = [c for c, _ in ranked[:n_good]]
+        bad = [c for c, _ in ranked[n_good:]] or good
+        best_cfg, best_score = None, None
+        for _ in range(self._n_candidates):
+            cfg: Dict[str, Any] = {}
+            total = 0.0
+            for p, v in self._leaves:
+                if isinstance(v, GridSearch):
+                    v = Categorical(v.values)
+                if not isinstance(v, Domain):
+                    _set_path(cfg, p, v)
+                    continue
+                gv = [_get_path(c, p) for c in good]
+                bv = [_get_path(c, p) for c in bad]
+                val, logratio = self._propose_axis(v, gv, bv)
+                _set_path(cfg, p, val)
+                total += logratio
+            if best_score is None or total > best_score:
+                best_cfg, best_score = cfg, total
+        return best_cfg
+
+    def _propose_axis(self, dom: Domain, good: list, bad: list):
+        import math
+
+        if isinstance(dom, Categorical):
+            cats = dom.categories
+            pg = _cat_probs(cats, good)
+            pb = _cat_probs(cats, bad)
+            i = self._rng.choices(range(len(cats)), weights=pg, k=1)[0]
+            return cats[i], math.log(pg[i]) - math.log(pb[i])
+        # numeric (Float / Integer): Parzen windows in (log-)space
+        is_int = isinstance(dom, Integer)
+        lo, hi = float(dom.lower), float(dom.upper)
+        log = getattr(dom, "log", False)
+        tf = math.log if log else (lambda x: float(x))
+        t_lo, t_hi = tf(lo), tf(hi)
+        g = [tf(v) for v in good]
+        b = [tf(v) for v in bad]
+        bw_g = max((t_hi - t_lo) / max(math.sqrt(len(g)), 1.0), 1e-9)
+        bw_b = max((t_hi - t_lo) / max(math.sqrt(len(b)), 1.0), 1e-9)
+        center = self._rng.choice(g)
+        x = min(max(self._rng.gauss(center, bw_g), t_lo), t_hi)
+        logratio = _parzen_logpdf(x, g, bw_g) - _parzen_logpdf(x, b, bw_b)
+        val = math.exp(x) if log else x
+        if is_int:
+            val = int(min(max(round(val), dom.lower), dom.upper - 1))
+            q = getattr(dom, "q", 1) or 1
+            val = (val // q) * q
+        elif getattr(dom, "q", None):
+            val = round(val / dom.q) * dom.q
+        return val, logratio
+
+
+def _get_path(cfg: dict, path):
+    d = cfg
+    for k in path:
+        d = d[k]
+    return d
+
+
+def _cat_probs(cats, values):
+    """Category probabilities with add-one smoothing."""
+    counts = [1.0] * len(cats)
+    index = {c if not isinstance(c, (list, dict)) else repr(c): i
+             for i, c in enumerate(cats)}
+    for v in values:
+        key = v if not isinstance(v, (list, dict)) else repr(v)
+        if key in index:
+            counts[index[key]] += 1.0
+    total = sum(counts)
+    return [c / total for c in counts]
+
+
+def _parzen_logpdf(x, centers, bw):
+    import math
+
+    # log-mean-exp of N(x; ci, bw) over centers
+    logs = [-0.5 * ((x - c) / bw) ** 2 - math.log(bw) for c in centers]
+    m = max(logs)
+    return m + math.log(sum(math.exp(v - m) for v in logs)
+                        / len(centers))

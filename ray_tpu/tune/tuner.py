@@ -78,6 +78,7 @@ class Tuner:
             metric=tc.metric,
             mode=tc.mode,
             max_concurrent=tc.max_concurrent_trials,
+            num_samples=tc.num_samples if tc.search_alg is not None else 0,
             resources_per_trial=tc.resources_per_trial,
             stop=getattr(self._rc, "stop", None),
             max_failures=tc.max_failures,

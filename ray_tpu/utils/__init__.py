@@ -1,1 +1,12 @@
-"""ray_tpu.utils — shared utilities and benchmark harnesses."""
+"""ray_tpu.utils — ecosystem shims, shared utilities, benchmark harnesses.
+
+Ref parity for the `ray.util` ecosystem surface: ActorPool
+(util/actor_pool.py), Queue (util/queue.py), multiprocessing Pool
+(util/multiprocessing/pool.py), joblib backend (util/joblib/).
+"""
+
+from ray_tpu.utils.actor_pool import ActorPool
+from ray_tpu.utils.joblib_backend import register_ray
+from ray_tpu.utils.queue import Empty, Full, Queue
+
+__all__ = ["ActorPool", "Queue", "Empty", "Full", "register_ray"]

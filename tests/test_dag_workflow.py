@@ -1,0 +1,131 @@
+"""DAG IR + durable workflows.
+
+Analogs of the reference's python/ray/dag/tests/test_function_dag.py,
+test_class_dag.py and python/ray/workflow/tests/test_basic_workflows.py /
+test_recovery.py (resume skips completed steps).
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+
+
+@ray_tpu.remote
+def _add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def _mul(a, b):
+    return a * b
+
+
+def test_function_dag(shared_ray):
+    with InputNode() as inp:
+        dag = _add.bind(_mul.bind(inp, 3), _add.bind(inp, 1))
+    # x=2: (2*3) + (2+1) = 9
+    assert ray_tpu.get(dag.execute(2), timeout=60) == 9
+    # re-executable with a different input
+    assert ray_tpu.get(dag.execute(10), timeout=60) == 41
+
+
+def test_diamond_dag_executes_shared_dep_once(shared_ray, tmp_path):
+    marker = tmp_path / "runs"
+
+    @ray_tpu.remote
+    def base():
+        with open(marker, "a") as f:
+            f.write("x")
+        return 5
+
+    b = base.bind()
+    dag = _add.bind(_mul.bind(b, 2), b)  # 5*2 + 5
+    assert ray_tpu.get(dag.execute(), timeout=60) == 15
+    assert marker.read_text() == "x"  # shared dep ran once
+
+
+def test_class_dag(shared_ray):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, x):
+            self.v += x
+            return self.v
+
+    node = Counter.bind(100)
+    dag = node.add.bind(_add.bind(1, 2))
+    assert ray_tpu.get(dag.execute(), timeout=60) == 103
+
+
+def test_workflow_run_and_output(shared_ray, tmp_path):
+    workflow.init(str(tmp_path))
+    dag = _add.bind(_mul.bind(2, 3), 4)
+    out = workflow.run(dag, workflow_id="w1")
+    assert out == 10
+    assert workflow.get_status("w1") == workflow.WorkflowStatus.SUCCESSFUL
+    assert workflow.get_output("w1") == 10
+    assert ("w1", "SUCCESSFUL") in workflow.list_all()
+
+
+def test_workflow_input(shared_ray, tmp_path):
+    workflow.init(str(tmp_path))
+    with InputNode() as inp:
+        dag = _mul.bind(inp, 7)
+    assert workflow.run(dag, workflow_id="w2", input=6) == 42
+
+
+def test_workflow_resume_skips_completed_steps(shared_ray, tmp_path):
+    workflow.init(str(tmp_path))
+    marker = tmp_path / "effects"
+    flag = tmp_path / "fail_once"
+    flag.write_text("1")
+
+    @ray_tpu.remote
+    def expensive():
+        with open(marker, "a") as f:
+            f.write("E")
+        return 21
+
+    @ray_tpu.remote(max_retries=0)
+    def flaky(x):
+        import os
+
+        if os.path.exists(flag):
+            raise RuntimeError("transient failure")
+        return x * 2
+
+    dag = flaky.bind(expensive.bind())
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w3")
+    assert workflow.get_status("w3") == workflow.WorkflowStatus.RESUMABLE
+    assert marker.read_text() == "E"  # expensive step completed + persisted
+
+    flag.unlink()  # heal the transient failure
+    assert workflow.resume("w3") == 42
+    assert workflow.get_status("w3") == workflow.WorkflowStatus.SUCCESSFUL
+    # the expensive step did NOT re-run — its checkpoint was reused
+    assert marker.read_text() == "E"
+
+
+def test_workflow_rejects_actor_nodes(shared_ray, tmp_path):
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    class A:
+        def f(self):
+            return 1
+
+    with pytest.raises(ValueError):
+        workflow.run(A.bind(), workflow_id="w4")
+
+
+def test_workflow_delete(shared_ray, tmp_path):
+    workflow.init(str(tmp_path))
+    workflow.run(_add.bind(1, 1), workflow_id="w5")
+    workflow.delete("w5")
+    with pytest.raises(ValueError):
+        workflow.get_status("w5")

@@ -206,3 +206,87 @@ class TestTuneIntegration:
         assert len(results) == 2
         df = {r.config["lr"] for r in results}
         assert df == {1e-4, 3e-4}
+
+
+class TestReplayBuffers:
+    """Analog of the reference's rllib/utils/replay_buffers tests."""
+
+    def test_uniform_ring(self):
+        import numpy as np
+
+        from ray_tpu.rllib import ReplayBuffer, SampleBatch
+
+        rb = ReplayBuffer(capacity=8, seed=0)
+        rb.add(SampleBatch({"obs": np.arange(12, dtype=np.float32)
+                            .reshape(12, 1), "a": np.arange(12)}))
+        assert len(rb) == 8 and rb.num_added == 12
+        s = rb.sample(16)
+        assert s.count == 16
+        # ring semantics: entries 0..3 were overwritten by 8..11
+        assert set(np.unique(s["a"])) <= set(range(4, 12))
+
+    def test_prioritized_sampling_skews_and_weights(self):
+        import numpy as np
+
+        from ray_tpu.rllib import PrioritizedReplayBuffer, SampleBatch
+
+        p = PrioritizedReplayBuffer(capacity=16, alpha=0.8, seed=1)
+        p.add(SampleBatch({"a": np.arange(10)}))
+        p.update_priorities(np.array([3]), np.array([100.0]))
+        s = p.sample(256, beta=0.4)
+        assert (s["batch_indexes"] == 3).mean() > 0.5
+        assert "weights" in s and s["weights"].max() <= 1.0 + 1e-6
+        # sum tree stays consistent after updates
+        assert abs(p._sum_tree[1]
+                   - p._sum_tree[p._tree_size:].sum()) < 1e-6
+
+
+class TestDQN:
+    def test_dqn_learns_stateless_guess(self, rt):
+        """Off-policy plumbing end-to-end on the 1-step env: reward 1 iff
+        the action matches the obs sign (random play = 0.5)."""
+        from ray_tpu.rllib import DQNConfig
+
+        cfg = (DQNConfig().environment("StatelessGuess-v0")
+               .rollouts(num_rollout_workers=2, num_envs_per_worker=4,
+                         rollout_fragment_length=16)
+               .training(train_batch_size=64, num_updates_per_iter=16,
+                         num_steps_sampled_before_learning_starts=128,
+                         epsilon_timesteps=1500,
+                         target_network_update_freq=256, lr=1e-3)
+               .debugging(seed=0))
+        algo = cfg.build()
+        best = 0.0
+        for _ in range(30):
+            r = algo.train()
+            best = max(best, r.get("episode_reward_mean", 0.0))
+            if best >= 0.95:
+                break
+        algo.cleanup()
+        assert best >= 0.9, f"DQN failed to learn: best={best}"
+
+    def test_dqn_cartpole_smoke_and_checkpoint(self, rt):
+        from ray_tpu.rllib import DQNConfig
+
+        cfg = (DQNConfig().environment("CartPole-v1")
+               .rollouts(num_rollout_workers=1, num_envs_per_worker=2,
+                         rollout_fragment_length=16)
+               .training(num_updates_per_iter=4,
+                         num_steps_sampled_before_learning_starts=32)
+               .debugging(seed=0))
+        algo = cfg.build()
+        r = algo.train()
+        assert r["epsilon"] > 0.9  # schedule starts near epsilon_initial
+        r = algo.train()
+        assert "loss" in r and r["replay_size"] > 0
+        ckpt = algo.save_checkpoint()
+        algo2 = cfg.build()
+        algo2.load_checkpoint(ckpt)
+        w1 = algo.get_policy_weights()
+        w2 = algo2.get_policy_weights()
+        import numpy as np
+
+        for k in w1:
+            np.testing.assert_allclose(w1[k], w2[k])
+        algo.cleanup()
+        algo2.cleanup()

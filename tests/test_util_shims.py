@@ -1,0 +1,162 @@
+"""Ecosystem shims: ActorPool, Queue, multiprocessing Pool, joblib.
+
+Analogs of the reference's python/ray/tests/test_actor_pool.py,
+test_queue.py, test_multiprocessing.py, test_joblib.py.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.utils import ActorPool, Empty, Full, Queue
+from ray_tpu.utils.multiprocessing import Pool
+
+
+@ray_tpu.remote
+class _Doubler:
+    def double(self, v):
+        return 2 * v
+
+    def slow_double(self, v):
+        time.sleep(0.05 * (v % 3))
+        return 2 * v
+
+
+def test_actor_pool_map_ordered(shared_ray):
+    pool = ActorPool([_Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [2 * v for v in range(8)]
+
+
+def test_actor_pool_map_unordered(shared_ray):
+    pool = ActorPool([_Doubler.remote() for _ in range(2)])
+    out = list(pool.map_unordered(
+        lambda a, v: a.slow_double.remote(v), range(6)))
+    assert sorted(out) == [2 * v for v in range(6)]
+
+
+def test_actor_pool_submit_get(shared_ray):
+    pool = ActorPool([_Doubler.remote()])
+    pool.submit(lambda a, v: a.double.remote(v), 10)
+    pool.submit(lambda a, v: a.double.remote(v), 20)  # queues (1 actor)
+    assert pool.has_next()
+    assert pool.get_next(timeout=60) == 20
+    assert pool.get_next(timeout=60) == 40
+    assert not pool.has_next()
+    with pytest.raises(StopIteration):
+        pool.get_next()
+
+
+def test_actor_pool_push_pop(shared_ray):
+    a1, a2 = _Doubler.remote(), _Doubler.remote()
+    pool = ActorPool([a1])
+    assert pool.pop_idle() is a1
+    assert pool.pop_idle() is None
+    pool.push(a1)
+    pool.push(a2)
+    assert pool.has_free()
+
+
+def test_queue_basic(shared_ray):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    assert q.qsize() == 2
+    assert q.full()
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.get() == 1
+    assert q.get() == 2
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get(block=False)
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    q.shutdown()
+
+
+def test_queue_cross_task(shared_ray):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    ray_tpu.get(producer.remote(q, 5), timeout=60)
+    got = [q.get(timeout=10) for _ in range(5)]
+    assert got == [0, 1, 2, 3, 4]
+    q.shutdown()
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_mp_pool_map_and_apply(shared_ray):
+    with Pool(processes=2) as p:
+        assert p.map(_sq, range(10)) == [x * x for x in range(10)]
+        assert p.apply(_add, (3, 4)) == 7
+        r = p.apply_async(_add, (1, 2))
+        assert r.get(timeout=60) == 3 and r.successful()
+        assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+        assert sorted(p.imap_unordered(_sq, range(6))) == \
+            [x * x for x in range(6)]
+        assert list(p.imap(_sq, range(6))) == [x * x for x in range(6)]
+
+
+def test_mp_pool_close_semantics(shared_ray):
+    p = Pool(processes=1)
+    p.close()
+    with pytest.raises(ValueError):
+        p.map(_sq, [1])
+    p.join()
+    p.terminate()
+
+
+def test_joblib_backend(shared_ray):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.utils import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray"):
+        out = joblib.Parallel(n_jobs=2)(
+            joblib.delayed(_sq)(i) for i in range(8))
+    assert out == [x * x for x in range(8)]
+
+
+def test_actor_pool_error_does_not_strand_pool(shared_ray):
+    """A failed task's ref must leave the bookkeeping with its error;
+    the next unordered get returns the OTHER task's result, not the
+    already-consumed exception."""
+    @ray_tpu.remote
+    class W:
+        def work(self, v):
+            if v == 0:
+                raise ValueError("boom")
+            return v
+
+    pool = ActorPool([W.remote()])
+    pool.submit(lambda a, v: a.work.remote(v), 0)
+    pool.submit(lambda a, v: a.work.remote(v), 5)  # queued (1 actor)
+    with pytest.raises(Exception):
+        pool.get_next(timeout=60)
+    assert pool.get_next_unordered(timeout=60) == 5
+    assert not pool.has_next()
+
+
+def test_headstore_rejects_second_live_head(tmp_path):
+    from ray_tpu.core.persistence import HeadStore
+
+    s1 = HeadStore(str(tmp_path))
+    with pytest.raises(RuntimeError):
+        HeadStore(str(tmp_path))
+    s1.close()
+    s2 = HeadStore(str(tmp_path))  # released lock can be re-acquired
+    s2.close()
