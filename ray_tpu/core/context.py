@@ -140,11 +140,22 @@ class CoreContext:
         self._cancelled: set = set()
         self._pinned: set = set()
         self._contained: Dict[ObjectID, list] = {}
+        # Borrow-handoff pins: refs we shipped inside a task RESULT stay
+        # pinned here for a grace window, so our BORROW_REMOVE cannot
+        # outrun the receiver's BORROW_ADD at the owner (chained borrow
+        # handoff, e.g. queue actors relaying refs). The reference closes
+        # this with borrow metadata embedded in replies; a TTL pin gives
+        # the same practical guarantee.
+        self._handoff_pins: deque = deque()
+        self._handoff_lock = threading.Lock()
         self._shutdown = False
         self._async_loop = None
         self._actors: Dict[ActorID, _ActorState] = {}
         self._pub_handlers: Dict[str, List] = {}
         self._pub_lock = threading.Lock()
+        # job-level runtime_env (init(runtime_env=...)): default for every
+        # task/actor submitted by this process unless overridden per-spec
+        self.job_runtime_env: Optional[dict] = None
 
         self.io = P.IOLoop(f"io-{self.worker_id[:6]}")
         # Own listener for direct pushes from peers. On a remote node
@@ -531,6 +542,8 @@ class CoreContext:
     # ================================================== GC callbacks
 
     def _free_owned_object(self, oid: ObjectID):
+        if self._shutdown:
+            return  # late GC-grace timer; stores/conns are torn down
         self._contained.pop(oid, None)
         with self._sub_lock:
             self._lineage.pop(oid, None)
@@ -574,7 +587,7 @@ class CoreContext:
 
     def submit_task(self, fn, args, kwargs, *, num_returns=1, resources=None,
                     strategy=None, max_retries=None, retry_exceptions=False,
-                    name="") -> List[ObjectRef]:
+                    name="", runtime_env=None) -> List[ObjectRef]:
         cfg = get_config()
         fn_id = self.fn_manager.export(fn)
         task_id = TaskID.for_normal_task(self.job_id)
@@ -589,6 +602,7 @@ class CoreContext:
                          if max_retries is None else max_retries),
             retry_exceptions=retry_exceptions,
             owner=self.worker_id,
+            runtime_env=runtime_env or self.job_runtime_env,
         )
         arg_ids, holder = self._encode_args(spec, args, kwargs)
         self.events.record(task_id.hex(), spec.name, task_events.SUBMITTED)
@@ -724,6 +738,7 @@ class CoreContext:
         while not self._shutdown:
             self._submit_event.wait(0.2)
             self._submit_event.clear()
+            self._purge_handoff_pins()
             try:
                 with self._sub_lock:
                     classes = list(self._classes.items())
@@ -1056,7 +1071,8 @@ class CoreContext:
 
     def create_actor(self, cls, args, kwargs, *, num_cpus=0, resources=None,
                      max_restarts=0, max_concurrency=1, name="",
-                     strategy=None, max_task_retries=0) -> "ActorID":
+                     strategy=None, max_task_retries=0,
+                     runtime_env=None) -> "ActorID":
         from .serialization import dumps
 
         fn_id = self.fn_manager.export(cls)
@@ -1075,6 +1091,7 @@ class CoreContext:
             owner=self.worker_id, actor_id=actor_id,
             max_restarts=max_restarts, max_concurrency=max_concurrency,
             max_retries=max_task_retries,
+            runtime_env=runtime_env or self.job_runtime_env,
         )
         self._encode_args(spec, args, kwargs)
         self.head.call(P.CREATE_ACTOR, dumps(spec), timeout=60)
@@ -1401,6 +1418,12 @@ class CoreContext:
                 str(i) for i in spec.tpu_ids)
         try:
             if spec.task_type == TaskType.ACTOR_CREATION:
+                if spec.runtime_env:
+                    # actor env persists for the actor process's lifetime
+                    # (the worker is dedicated) — enter without exit
+                    from ray_tpu import runtime_env as _renv
+
+                    _renv.applied(self, spec.runtime_env).__enter__()
                 cls = self.fn_manager.fetch(spec.function_id)
                 args, kwargs = self._decode_args(spec)
                 self._actor_instance = cls(*args, **kwargs)
@@ -1421,6 +1444,13 @@ class CoreContext:
                 fn = getattr(self._actor_instance, spec.method_name)
                 args, kwargs = self._decode_args(spec)
                 result = self._call(fn, args, kwargs)
+            elif spec.runtime_env:
+                from ray_tpu import runtime_env as _renv
+
+                fn = self.fn_manager.fetch(spec.function_id)
+                args, kwargs = self._decode_args(spec)
+                with _renv.applied(self, spec.runtime_env):
+                    result = self._call(fn, args, kwargs)
             else:
                 fn = self.fn_manager.fetch(spec.function_id)
                 args, kwargs = self._decode_args(spec)
@@ -1478,6 +1508,8 @@ class CoreContext:
         meta = []
         for oid, value in zip(spec.return_ids(), results):
             sv = serialize(value)
+            if sv.contained_refs:
+                self._pin_for_handoff(sv.contained_refs)
             if sv.total_bytes < cfg.max_inline_object_size and \
                     not sv.contained_refs:
                 # out-of-band frames may be memoryviews (PickleBuffer.raw);
@@ -1493,6 +1525,20 @@ class CoreContext:
                                sv.total_bytes, spec.owner)
                 meta.append(("p", self.node_idx))
         return meta
+
+    def _pin_for_handoff(self, refs, ttl_s: float = 5.0):
+        with self._handoff_lock:
+            self._handoff_pins.append((time.monotonic() + ttl_s,
+                                       list(refs)))
+        self._purge_handoff_pins()
+
+    def _purge_handoff_pins(self):
+        """Also driven by the submitter loop's wakeups, so the LAST batch
+        of pinned refs releases on time instead of leaking until exit."""
+        now = time.monotonic()
+        with self._handoff_lock:
+            while self._handoff_pins and self._handoff_pins[0][0] < now:
+                self._handoff_pins.popleft()
 
     def _graceful_exit(self):
         self._shutdown = True

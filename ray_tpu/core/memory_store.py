@@ -42,7 +42,10 @@ class _Waiter:
 
 class MemoryStore:
     def __init__(self):
-        self._lock = threading.Lock()
+        # RLock: evicting an entry can decref contained ObjectRefs whose
+        # __del__ cascades (remove_local_ref -> borrow release -> evict)
+        # back into this store while the lock is held.
+        self._lock = threading.RLock()
         self._entries: Dict[ObjectID, _Entry] = {}
         self._callbacks: Dict[ObjectID, List[Callable]] = {}
         self._waiters: Dict[ObjectID, List[_Waiter]] = {}

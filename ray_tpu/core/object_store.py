@@ -115,6 +115,11 @@ class ShmObjectStore:
 
     def create(self, object_id: ObjectID, data_size: int, meta_size: int = 0
                ) -> memoryview:
+        # every native entry point checks _closed: shutdown destroys the
+        # C-side handle, and late daemon threads (GC grace timers, event
+        # flushers) calling in afterwards would use-after-free it
+        if self._closed:
+            raise ObjectStoreFullError(f"store {self.name} is closed")
         lib = get_lib()
         off = lib.shm_store_create_object(
             self._h, object_id.binary(), data_size, meta_size)
@@ -132,11 +137,15 @@ class ShmObjectStore:
         return memoryview(self._mmap)[off:off + data_size + meta_size]
 
     def seal(self, object_id: ObjectID):
+        if self._closed:
+            return
         if get_lib().shm_store_seal(self._h, object_id.binary()) != 0:
             raise KeyError(f"seal failed for {object_id.hex()}")
 
     def get(self, object_id: ObjectID) -> Optional[Tuple[memoryview, memoryview]]:
         """Returns (data, metadata) views, pinning the object; None if absent."""
+        if self._closed:
+            return None
         out = (ctypes.c_uint64 * 3)()
         rc = get_lib().shm_store_get(self._h, object_id.binary(), out)
         if rc != 0:
@@ -146,15 +155,23 @@ class ShmObjectStore:
         return mv[off:off + dsize], mv[off + dsize:off + dsize + msize]
 
     def contains(self, object_id: ObjectID) -> bool:
+        if self._closed:
+            return False
         return get_lib().shm_store_contains(self._h, object_id.binary()) == 1
 
     def release(self, object_id: ObjectID):
+        if self._closed:
+            return
         get_lib().shm_store_release(self._h, object_id.binary())
 
     def delete(self, object_id: ObjectID) -> bool:
+        if self._closed:
+            return False
         return get_lib().shm_store_delete(self._h, object_id.binary()) == 0
 
     def evict(self, need: int) -> List[ObjectID]:
+        if self._closed:
+            return []
         buf = ctypes.create_string_buffer(_ID_SIZE * 256)
         n = get_lib().shm_store_evict(self._h, need, buf, 256)
         return [
@@ -162,12 +179,18 @@ class ShmObjectStore:
         ]
 
     def bytes_in_use(self) -> int:
+        if self._closed:
+            return 0
         return get_lib().shm_store_bytes_in_use(self._h)
 
     def capacity(self) -> int:
+        if self._closed:
+            return 0
         return get_lib().shm_store_capacity(self._h)
 
     def num_objects(self) -> int:
+        if self._closed:
+            return 0
         return get_lib().shm_store_num_objects(self._h)
 
     # -- serialized-value interface ------------------------------------------

@@ -22,6 +22,35 @@ from .plan import (ActorPoolStrategy, AllToAll, InputData, Limit, MapBlocks,
                    Plan, Read, Union as UnionOp, Zip)
 
 
+class _QueueRefStream:
+    """Picklable one-shot block-ref source draining a Queue actor (the
+    streaming_split consumer end; None is the end-of-stream sentinel)."""
+
+    def __init__(self, q):
+        self._q = q
+        self._exhausted = False
+
+    def __iter__(self):
+        if self._exhausted:
+            raise RuntimeError(
+                "this streaming_split iterator is one-shot and already "
+                "drained — call streaming_split again for another epoch")
+        while True:
+            item = self._q.get(timeout=600)
+            if item is None or (isinstance(item, tuple) and
+                                item[0] == "__stream_error__"):
+                self._exhausted = True
+                try:
+                    self._q.shutdown()
+                except Exception:  # noqa: BLE001 — already gone
+                    pass
+                if item is not None:
+                    raise RuntimeError(
+                        f"streaming_split execution failed: {item[1]}")
+                return
+            yield item[0]  # [ref] wrapping, see streaming_split pump
+
+
 def _plan_from_refs(refs: List[Any]) -> Plan:
     return Plan([InputData(name="input_data", block_refs=list(refs))])
 
@@ -289,10 +318,81 @@ class Dataset:
 
     def streaming_split(self, n: int, *, equal: bool = True,
                         locality_hints=None) -> List[DataIterator]:
-        """Per-consumer iterators for Train ingest (ref: streaming_split +
-        stream_split_iterator.py)."""
-        return [DataIterator(ds._refs(), name=f"split_{i}")
-                for i, ds in enumerate(self.split(n, equal=equal))]
+        """Per-consumer iterators over ONE shared streaming execution
+        (ref: streaming_split + output_splitter.py:19): blocks are dealt
+        round-robin to n bounded per-consumer queues as they are
+        produced; a lagging consumer's full queue pauses the pump, which
+        pauses upstream task submission (backpressure all the way to the
+        source) instead of materializing the dataset. One-shot: iterate
+        each split once per execution (call again for another epoch).
+
+        If the plan was already executed (cached refs), the cached blocks
+        are dealt instead — same consumer API, no re-execution.
+
+        The per-consumer queues are Queue ACTORS, so the returned
+        iterators are picklable and consumable from Train worker
+        processes (the driver-side pump thread feeds them).
+
+        ``equal=True`` deals whole ROUNDS of n blocks and drops a trailing
+        partial round, so every consumer receives the same block count
+        (the reference's equal splits may likewise drop tail rows to
+        equalize; row counts still vary with block sizes).
+        ``locality_hints`` is accepted for API parity and ignored — the
+        queues live with the driver, not on consumer nodes."""
+        import threading
+
+        from ray_tpu.utils.queue import Queue
+
+        from .executor import StreamingExecutor
+
+        if self._cached_refs is not None:
+            gen = iter(self._cached_refs)
+        else:
+            gen = StreamingExecutor(self._plan).execute_streaming()
+        queues: List[Queue] = [Queue(maxsize=4) for _ in range(n)]
+
+        def pump():
+            # wrapped [ref]: a bare ObjectRef argument would be resolved
+            # to its value on the queue actor; the list stores the REF
+            error = None
+            try:
+                if equal:
+                    rounds = 0
+                    round_buf = []
+                    for ref in gen:
+                        round_buf.append(ref)
+                        if len(round_buf) == n:
+                            for q, r in zip(queues, round_buf):
+                                q.put([r], timeout=None)
+                            round_buf.clear()
+                            rounds += 1
+                    if round_buf and rounds == 0:
+                        # fewer blocks than consumers: equality is
+                        # impossible, but dropping 100% of the data
+                        # would be worse — deal what exists
+                        for q, r in zip(queues, round_buf):
+                            q.put([r], timeout=None)
+                    # otherwise the trailing partial round is dropped
+                    # (see docstring)
+                else:
+                    for i, ref in enumerate(gen):
+                        queues[i % n].put([ref], timeout=None)
+            except BaseException as e:  # noqa: BLE001 — surface downstream
+                error = e
+            finally:
+                for q in queues:
+                    try:
+                        # error sentinel re-raises at every consumer — a
+                        # silent clean end would truncate the dataset
+                        q.put(("__stream_error__", repr(error))
+                              if error is not None else None)
+                    except Exception:  # noqa: BLE001 — consumer gone
+                        pass
+
+        threading.Thread(target=pump, daemon=True,
+                         name="streaming-split-pump").start()
+        return [DataIterator(_QueueRefStream(q), name=f"split_{i}")
+                for i, q in enumerate(queues)]
 
     def train_test_split(self, test_size: float, *,
                          shuffle: bool = False,

@@ -11,7 +11,9 @@ the reference's push-based shuffle.
 
 from __future__ import annotations
 
+import itertools
 import random
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import ray_tpu
@@ -21,9 +23,23 @@ from .block import BlockAccessor, batch_to_block, build_block
 from .plan import (ActorPoolStrategy, AllToAll, InputData, Limit, MapBlocks,
                    Plan, Read, Union, Zip)
 
-# bounded in-flight tasks per stage (the streaming backpressure knob;
-# ref: streaming executor resource budgets)
-_MAX_IN_FLIGHT = 16
+def _inflight_budget() -> int:
+    """Per-stage submitted-but-unconsumed window (streaming backpressure).
+
+    Resource-aware, like the reference's streaming executor budgets
+    (streaming_executor_state.py): 2 tasks per cluster CPU keeps every
+    core busy while one block per core is in flight downstream, instead
+    of a hard-coded constant. Overridable via RAY_TPU_DATA_INFLIGHT."""
+    import os
+
+    override = os.environ.get("RAY_TPU_DATA_INFLIGHT")
+    if override:
+        return max(1, int(override))
+    try:
+        cpus = ray_tpu.cluster_resources().get("CPU", 4)
+    except Exception:  # noqa: BLE001 — not initialized yet
+        cpus = 4
+    return max(4, int(2 * cpus))
 
 
 # ------------------------------------------------------------ fused mapper
@@ -187,6 +203,21 @@ def _sample_keys(block, key, k: int):
 # --------------------------------------------------------------- executor
 
 
+def _stream_stage(remote_fn, arg_iter):
+    """Consumer-paced submission: keep at most the budget's worth of
+    tasks submitted ahead of what downstream has pulled. Downstream map
+    tasks wait on their input objects through the object plane, so block
+    A can be in stage 3 while block B is still being read."""
+    budget = _inflight_budget()
+    pending: "deque" = deque()
+    for args in arg_iter:
+        pending.append(remote_fn.remote(*args))
+        if len(pending) >= budget:
+            yield pending.popleft()
+    while pending:
+        yield pending.popleft()
+
+
 class StreamingExecutor:
     def __init__(self, plan: Plan):
         self.plan = plan
@@ -194,9 +225,18 @@ class StreamingExecutor:
     # stage compilation: group the linear op chain into
     # [source] [fused maps | barrier | limit | union | zip]*
     def execute(self) -> List[ObjectRef]:
+        return list(self.execute_streaming())
+
+    def execute_streaming(self):
+        """Lazy block-ref generator: map stages submit one task per block
+        pulled by the consumer (window = _inflight_budget()), so a slow
+        consumer pauses submission instead of the whole dataset
+        materializing (ref: streaming_executor.py pull-based operators).
+        Barrier ops (shuffle/sort/groupby/zip) drain their upstream —
+        they need every block by definition."""
         ops = self.plan.ops
         assert ops, "empty plan"
-        refs = self._run_source(ops[0])
+        gen = self._stream_source(ops[0])
         i = 1
         while i < len(ops):
             op = ops[i]
@@ -207,60 +247,46 @@ class StreamingExecutor:
                     fused.append(ops[i])
                     i += 1
                 if fused:
-                    refs = self._run_fused_maps(fused, refs)
+                    gen = self._stream_fused_maps(fused, gen)
                     continue
                 # actor-pool stage (not fused with task stages)
-                refs = self._run_actor_pool(op, refs)
+                gen = iter(self._run_actor_pool(op, list(gen)))
                 i += 1
             elif isinstance(op, AllToAll):
-                refs = self._run_all_to_all(op, refs)
+                gen = iter(self._run_all_to_all(op, list(gen)))
                 i += 1
             elif isinstance(op, Limit):
-                refs = self._run_limit(op, refs)
+                gen = iter(self._run_limit(op, list(gen)))
                 i += 1
             elif isinstance(op, Union):
-                for other in op.others:
-                    refs = refs + StreamingExecutor(other).execute()
+                gen = itertools.chain(
+                    gen, *(StreamingExecutor(other).execute_streaming()
+                           for other in op.others))
                 i += 1
             elif isinstance(op, Zip):
-                refs = self._run_zip(op, refs)
+                gen = iter(self._run_zip(op, list(gen)))
                 i += 1
             else:
                 raise ValueError(f"unexpected op {op}")
-        return refs
+        yield from gen
 
     # ------------------------------------------------------------- stages
 
-    def _run_source(self, op) -> List[ObjectRef]:
+    def _stream_source(self, op):
         if isinstance(op, InputData):
-            return list(op.block_refs)
+            yield from list(op.block_refs)
+            return
         assert isinstance(op, Read)
         parallelism = op.parallelism if op.parallelism > 0 else \
             max(2, int(ray_tpu.cluster_resources().get("CPU", 2)))
         tasks = op.datasource.get_read_tasks(parallelism)
         read = ray_tpu.remote(lambda t: t())
-        return self._bounded_submit(read, [(t,) for t in tasks])
+        yield from _stream_stage(read, ((t,) for t in tasks))
 
-    def _run_fused_maps(self, fused: List[MapBlocks],
-                        refs: List[ObjectRef]) -> List[ObjectRef]:
+    def _stream_fused_maps(self, fused: List[MapBlocks], gen):
         run = ray_tpu.remote(_run_fused)
-        return self._bounded_submit(
-            run, [(fused, r, i) for i, r in enumerate(refs)])
-
-    def _bounded_submit(self, remote_fn, arg_tuples) -> List[ObjectRef]:
-        """Submit with bounded in-flight work (streaming backpressure):
-        at most _MAX_IN_FLIGHT upstream tasks run at once; completed ones
-        immediately free a slot for the next."""
-        out: List[ObjectRef] = []
-        in_flight: List[ObjectRef] = []
-        for args in arg_tuples:
-            if len(in_flight) >= _MAX_IN_FLIGHT:
-                done, in_flight = ray_tpu.wait(
-                    in_flight, num_returns=1, timeout=None)
-            ref = remote_fn.remote(*args)
-            out.append(ref)
-            in_flight.append(ref)
-        return out
+        return _stream_stage(
+            run, ((fused, r, i) for i, r in enumerate(gen)))
 
     def _run_actor_pool(self, op: MapBlocks,
                         refs: List[ObjectRef]) -> List[ObjectRef]:

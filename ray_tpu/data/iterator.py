@@ -18,8 +18,11 @@ from .block import BlockAccessor
 
 
 class DataIterator:
-    def __init__(self, block_refs: List[Any], name: str = "shard"):
-        self._refs = list(block_refs)
+    def __init__(self, block_refs, name: str = "shard"):
+        # a list (re-iterable, multi-epoch) or any iterable of refs (the
+        # one-shot, picklable streaming_split consumer streams); iter()
+        # is taken lazily per iter_* call, never at construction
+        self._refs = block_refs
         self._name = name
 
     def iter_rows(self) -> Iterator[Any]:
@@ -94,10 +97,12 @@ class DataIterator:
     def materialize(self):
         from .dataset import Dataset, _plan_from_refs
 
-        return Dataset(_plan_from_refs(self._refs))
+        return Dataset(_plan_from_refs(list(self._refs)))
 
     def stats(self) -> str:
-        return f"DataIterator({self._name}, {len(self._refs)} blocks)"
+        if isinstance(self._refs, list):
+            return f"DataIterator({self._name}, {len(self._refs)} blocks)"
+        return f"DataIterator({self._name}, streaming)"
 
 
 def _rows_to_batch(rows: List[Any], batch_format: str):
