@@ -49,6 +49,7 @@ from ray_tpu.core.exceptions import (
     WorkerCrashedError,
 )
 from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.tracing import timeline
 
 __all__ = [
     "__version__", "init", "shutdown", "remote", "get", "put", "wait",
@@ -57,7 +58,7 @@ __all__ = [
     "ActorClass", "ActorHandle", "PlacementGroup", "placement_group",
     "remove_placement_group", "placement_group_table",
     "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
-    "nodes", "cluster_resources", "available_resources",
+    "nodes", "cluster_resources", "available_resources", "timeline",
     "RayTaskError", "ActorDiedError", "ActorUnavailableError",
     "GetTimeoutError", "ObjectLostError", "TaskCancelledError",
     "WorkerCrashedError",

@@ -37,7 +37,8 @@ def init(*, num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
          labels: dict = None, _system_config: dict = None,
          ignore_reinit_error: bool = False, log_to_driver: bool = True,
          namespace: str = "", address: Optional[str] = None,
-         session_dir: Optional[str] = None) -> "RuntimeInfo":
+         session_dir: Optional[str] = None,
+         runtime_env: Optional[dict] = None) -> "RuntimeInfo":
     """Start (or connect to) a runtime.
 
     With no address, starts an embedded head (GCS-lite + one node) in this
@@ -69,6 +70,7 @@ def init(*, num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
             set_context(ctx)
             if log_to_driver:
                 _mirror_worker_logs(ctx)
+            _apply_job_runtime_env(ctx, runtime_env)
             return RuntimeInfo(ctx, None)
         session_name = uuid.uuid4().hex[:10]
         if session_dir is None:
@@ -84,9 +86,20 @@ def init(*, num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
         set_context(ctx)
         if log_to_driver:
             _mirror_worker_logs(ctx)
+        _apply_job_runtime_env(ctx, runtime_env)
         _head = head
         atexit.register(shutdown)
         return RuntimeInfo(ctx, head)
+
+
+def _apply_job_runtime_env(ctx: CoreContext, runtime_env: Optional[dict]):
+    """Job-level default env for every task/actor (reference:
+    ray.init(runtime_env=...))."""
+    if not runtime_env:
+        return
+    from ray_tpu.runtime_env import upload, validate
+
+    ctx.job_runtime_env = upload(ctx, validate(runtime_env))
 
 
 def _mirror_worker_logs(ctx: CoreContext):
@@ -177,7 +190,9 @@ def kill(actor: "ActorHandle", *, no_restart: bool = True):
 class RemoteFunction:
     def __init__(self, fn, *, num_cpus=None, num_tpus=None, num_returns=1,
                  resources=None, max_retries=None, retry_exceptions=False,
-                 scheduling_strategy=None, name=None):
+                 scheduling_strategy=None, name=None, runtime_env=None):
+        from ray_tpu.runtime_env import validate as _validate_env
+
         self._fn = fn
         self._num_returns = num_returns
         self._resources = _resource_dict(num_cpus, num_tpus, resources,
@@ -186,7 +201,18 @@ class RemoteFunction:
         self._retry_exceptions = retry_exceptions
         self._strategy = scheduling_strategy
         self._name = name or getattr(fn, "__name__", "task")
+        self._runtime_env = _validate_env(runtime_env)
+        self._uploaded_env = None  # dirs packed/uploaded once, lazily
         functools.update_wrapper(self, fn)
+
+    def _resolved_env(self):
+        if self._runtime_env is None:
+            return None
+        if self._uploaded_env is None:
+            from ray_tpu.runtime_env import upload
+
+            self._uploaded_env = upload(get_context(), self._runtime_env)
+        return self._uploaded_env
 
     def __call__(self, *a, **k):
         raise TypeError(
@@ -206,7 +232,8 @@ class RemoteFunction:
             strategy=_to_strategy(self._strategy),
             max_retries=self._max_retries,
             retry_exceptions=self._retry_exceptions,
-            name=self._name)
+            name=self._name,
+            runtime_env=self._resolved_env())
         return refs[0] if self._num_returns == 1 else refs
 
     def bind(self, *args, **kwargs):
@@ -221,7 +248,8 @@ class RemoteFunction:
             num_returns=self._num_returns,
             resources=None, max_retries=self._max_retries,
             retry_exceptions=self._retry_exceptions,
-            scheduling_strategy=self._strategy, name=self._name)
+            scheduling_strategy=self._strategy, name=self._name,
+            runtime_env=self._runtime_env)
         merged.update(opts)
         rf = RemoteFunction(self._fn, **{k: v for k, v in merged.items()
                                          if k in inspect.signature(
@@ -283,7 +311,12 @@ class ActorHandle:
 class ActorClass:
     def __init__(self, cls, *, num_cpus=None, num_tpus=None, resources=None,
                  max_restarts=0, max_task_retries=0, max_concurrency=1,
-                 name=None, scheduling_strategy=None, lifetime=None):
+                 name=None, scheduling_strategy=None, lifetime=None,
+                 runtime_env=None):
+        from ray_tpu.runtime_env import validate as _validate_env
+
+        self._runtime_env = _validate_env(runtime_env)
+        self._uploaded_env = None
         self._cls = cls
         self._resources = _resource_dict(num_cpus, num_tpus, resources,
                                          default_cpus=0)
@@ -301,6 +334,13 @@ class ActorClass:
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         ctx = get_context()
+        renv = None
+        if self._runtime_env is not None:
+            if self._uploaded_env is None:
+                from ray_tpu.runtime_env import upload
+
+                self._uploaded_env = upload(ctx, self._runtime_env)
+            renv = self._uploaded_env
         actor_id = ctx.create_actor(
             self._cls, args, kwargs,
             resources=self._resources,
@@ -308,7 +348,8 @@ class ActorClass:
             max_concurrency=self._max_concurrency,
             name=self._name or "",
             strategy=_to_strategy(self._strategy),
-            max_task_retries=self._max_task_retries)
+            max_task_retries=self._max_task_retries,
+            runtime_env=renv)
         return ActorHandle(actor_id, _public_methods(self._cls),
                            self._max_task_retries)
 
@@ -324,7 +365,8 @@ class ActorClass:
                     max_task_retries=self._max_task_retries,
                     max_concurrency=self._max_concurrency, name=self._name,
                     scheduling_strategy=self._strategy,
-                    lifetime=self._lifetime)
+                    lifetime=self._lifetime,
+                    runtime_env=self._runtime_env)
         base.update(opts)
         ac = ActorClass(self._cls, **base)
         if "resources" not in opts and "num_cpus" not in opts \
@@ -356,12 +398,12 @@ def remote(*args, **kwargs):
         if inspect.isclass(obj):
             allowed = ("num_cpus", "num_tpus", "resources", "max_restarts",
                        "max_task_retries", "max_concurrency", "name",
-                       "scheduling_strategy", "lifetime")
+                       "scheduling_strategy", "lifetime", "runtime_env")
             return ActorClass(obj, **{k: v for k, v in kwargs.items()
                                       if k in allowed})
         allowed = ("num_cpus", "num_tpus", "num_returns", "resources",
                    "max_retries", "retry_exceptions", "scheduling_strategy",
-                   "name")
+                   "name", "runtime_env")
         return RemoteFunction(obj, **{k: v for k, v in kwargs.items()
                                       if k in allowed})
 

@@ -80,6 +80,10 @@ class Config:
     # Durable head WAL (reference: GCS Redis-backed store client —
     # redis_store_client.h). Restores KV / named actors / PGs on restart.
     head_persistence: bool = True
+    # OOM control (reference: memory_monitor.h:52 — 0.95 threshold,
+    # 250ms refresh). refresh <= 0 disables the monitor.
+    memory_usage_threshold: float = 0.95
+    memory_monitor_refresh_s: float = 0.25
 
     # --- logging / events ---
     log_dir: str = ""

@@ -97,6 +97,10 @@ class NodeState:
     session_dir: str = ""
     # the host's peer-to-peer object TransferServer (object_transfer.py)
     transfer_addr: str = ""
+    # periodic health probing (remote nodes; gcs_health_check_manager.h:39)
+    health_failures: int = 0
+    last_ping: float = 0.0
+    ping_inflight: bool = False
 
     @property
     def is_remote(self) -> bool:
@@ -158,6 +162,8 @@ class Head:
         self.task_events: "deque" = deque(
             maxlen=get_config().task_event_buffer_size)
         self.task_events_dropped = 0
+        # cluster-merged metrics: (name, tags_key) -> row dict
+        self.metrics: Dict[tuple, dict] = {}
         self._log_monitor = None
         # Durable control-plane WAL (reference: GCS Redis store client).
         self._persist: Optional[HeadStore] = None
@@ -182,6 +188,12 @@ class Head:
             self.session_dir,
             lambda ch, data: self._publish(ch, dumps(data)))
         self._log_monitor.start()
+        # OOM control: kill the newest busy worker under memory pressure
+        # (reference: memory_monitor.h:52 + retriable-LIFO kill policy)
+        from .memory_monitor import MemoryMonitor
+
+        self._memory_monitor = MemoryMonitor(self)
+        self._memory_monitor.start()
         # Housekeeping loop: pending-PG retries and idle-worker reaping
         # must not depend on any client calling in — a placement group
         # that couldn't be placed at creation (resources transiently held
@@ -1380,6 +1392,37 @@ class Head:
 
     # ------------------------------------------------------------ cluster info
 
+    def _h_metrics_report(self, conn, rid, batch):
+        """Merge per-process metric deltas into the cluster aggregate
+        (reference: opencensus exporter -> dashboard agent; stats/
+        metric.h:103). Counters/histograms arrive as deltas and sum;
+        gauges overwrite."""
+        with self._lock:
+            for kind, name, desc, meta, tags_key, value in batch:
+                key = (name, tags_key)
+                row = self.metrics.get(key)
+                if row is None:
+                    if kind == "histogram":
+                        tag_keys, boundaries = meta
+                    else:
+                        tag_keys, boundaries = meta, None
+                    row = self.metrics[key] = {
+                        "name": name, "kind": kind, "description": desc,
+                        "tags": dict(zip(tag_keys, tags_key)),
+                        "boundaries": boundaries,
+                        "value": list(value) if kind == "histogram"
+                        else 0.0,
+                    }
+                    if kind == "histogram":
+                        continue
+                if kind == "gauge":
+                    row["value"] = value
+                elif kind == "counter":
+                    row["value"] += value
+                else:  # histogram delta: element-wise sum
+                    row["value"] = [a + b for a, b in
+                                    zip(row["value"], value)]
+
     def _h_task_events(self, conn, rid, batch, dropped):
         """Workers' task-state transitions land in a bounded ring buffer
         (reference: GcsTaskManager; src/ray/gcs/gcs_server/gcs_task_manager.h)."""
@@ -1426,6 +1469,16 @@ class Head:
                     "spilled": bool(loc.spilled_path),
                 } for oid, loc in self.objects.items()
                     if loc.node_idx >= 0 or loc.spilled_path]
+            elif kind == "metrics":
+                rows = list(self.metrics.values())
+            elif kind == "task_events":
+                # raw transition log (timeline/tracing export)
+                rows = [{
+                    "task_id": tid, "name": name, "state": state,
+                    "worker_id": wid, "node_idx": nidx, "ts": ts,
+                    "error": err,
+                } for (tid, name, state, wid, nidx, ts, err)
+                    in self.task_events]
             elif kind == "tasks":
                 # newest state wins per task id; newest tasks first
                 latest: Dict[str, dict] = {}
@@ -1505,6 +1558,7 @@ class Head:
         P.TASK_EVENTS: _h_task_events,
         P.STATE_QUERY: _h_state_query,
         P.SEAL_ABORTED: _h_seal_aborted,
+        P.METRICS_REPORT: _h_metrics_report,
     }
 
     def _forward_to_worker(self, worker_id: str, mt: int, *fields):
@@ -1531,6 +1585,41 @@ class Head:
         with self._lock:
             self._wal_backlog.append(rec)
 
+    def _health_check(self):
+        """Probe remote agents on a period; evict after N consecutive
+        failures. Socket-close detection only catches DEAD agents — a
+        WEDGED one (process alive, event loop stuck) keeps its socket
+        open forever; the probe is what evicts it (reference: 3s period /
+        5 failures, gcs_health_check_manager.h:39, ray_config_def.h)."""
+        cfg = get_config()
+        now = time.monotonic()
+        with self._lock:
+            targets = [
+                n for n in self.nodes.values()
+                if n.is_remote and n.alive and not n.ping_inflight
+                and now - n.last_ping >= cfg.health_check_period_s
+            ]
+            for n in targets:
+                n.ping_inflight = True
+        for node in targets:
+            threading.Thread(target=self._ping_node, args=(node,),
+                             daemon=True, name="health-probe").start()
+
+    def _ping_node(self, node: NodeState):
+        cfg = get_config()
+        try:
+            node.agent_conn.call(
+                P.PING, timeout=max(cfg.health_check_period_s, 1.0))
+            node.health_failures = 0
+        except Exception:  # noqa: BLE001 — timeout or conn error
+            node.health_failures += 1
+            if node.health_failures >= \
+                    cfg.health_check_failure_threshold and node.alive:
+                self.remove_node(node.idx)
+        finally:
+            node.last_ping = time.monotonic()
+            node.ping_inflight = False
+
     def _drain_wal_backlog(self):
         if self._persist is None:
             return
@@ -1554,11 +1643,27 @@ class Head:
         """Housekeeping: PG retries, lease grants, idle worker reaping.
         Driven by the head's own keeper thread (and callable from tests)."""
         self._drain_wal_backlog()
+        self._health_check()
         self._retry_pending_pgs()
         self._try_fulfill_pending()
         cfg = get_config()
         now = time.monotonic()
         with self._lock:
+            # sweep ghost workers: a spawn whose process died (or whose
+            # request was lost) before registering would otherwise sit in
+            # "starting" forever, looking busy to idle-node accounting
+            for node in self.nodes.values():
+                for w in list(node.workers.values()):
+                    if w.state == "starting" and now - w.spawned_at > \
+                            cfg.worker_register_timeout_s:
+                        self._kill_worker_process(w)
+                        if node.is_remote and node.agent_conn is not None:
+                            try:
+                                node.agent_conn.send(P.KILL_WORKER,
+                                                     w.worker_id)
+                            except P.ConnectionLost:
+                                pass
+                        node.workers.pop(w.worker_id, None)
             for node in self.nodes.values():
                 for cls, lst in list(node.idle_by_class.items()):
                     keep = []
@@ -1575,6 +1680,8 @@ class Head:
         self._shutdown = True
         if self._log_monitor is not None:
             self._log_monitor.stop()
+        if getattr(self, "_memory_monitor", None) is not None:
+            self._memory_monitor.stop()
         with self._lock:
             workers = [w for n in self.nodes.values()
                        for w in n.workers.values()]

@@ -99,6 +99,8 @@ STATE_QUERY = 64        # (kind, limit) -> ([rows],) observability state API
 SEAL_ABORTED = 65       # owner->head: ([oid_bins],) the creating task failed
                         # permanently — these ids will never seal; fail any
                         # blocked locate waiters instead of hanging them
+METRICS_REPORT = 66     # ([(kind, name, desc, meta, tags_key, value)],)
+                        # per-process metric deltas -> head aggregate
 
 # High bit of the length prefix marks a RAW frame: the payload is
 # unpickled bytes (bulk data follows its pickled header message). Sending

@@ -1,0 +1,238 @@
+"""Application + system metrics: Counter / Gauge / Histogram.
+
+Ref parity: ray.util.metrics (python/ray/util/metrics.py Counter/Gauge/
+Histogram over src/ray/stats/metric.h:103). Re-designed transport: each
+process aggregates locally (tag-tuple -> float or bucket counts) and a
+pusher thread flushes deltas to the head over the existing control
+connection; the head merges per (name, tags) so `metrics_summary()` /
+`python -m ray_tpu list metrics`-style queries see cluster totals. No
+Prometheus/OpenCensus dependency — the head table IS the scrape target
+(`export_prometheus()` renders the text exposition format).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_FLUSH_PERIOD_S = 2.0
+_registry_lock = threading.Lock()
+_registry: List["Metric"] = []
+_pusher_started = False
+
+
+def _tags_key(tags: Optional[Dict[str, str]], tag_keys: Sequence[str]
+              ) -> Tuple[str, ...]:
+    tags = tags or {}
+    return tuple(str(tags.get(k, "")) for k in tag_keys)
+
+
+class Metric:
+    """Base: local aggregation + registration with the pusher."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        if not name:
+            raise ValueError("metric name is required")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._default_tags: Dict[str, str] = {}
+        # registration LAST: the pusher snapshots registered metrics from
+        # its own thread, so the instance must be fully initialized first
+        # (subclasses with extra state register themselves instead)
+        if type(self)._registers_in_base:
+            self._register()
+
+    _registers_in_base = True
+
+    def _register(self):
+        with _registry_lock:
+            _registry.append(self)
+        _ensure_pusher()
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags):
+        if self._default_tags:
+            merged = dict(self._default_tags)
+            merged.update(tags or {})
+            return merged
+        return tags
+
+    # pusher protocol: drain (and reset deltas for counters)
+    def _snapshot(self) -> List[tuple]:
+        with self._lock:
+            out = [(self.kind, self.name, self.description, self.tag_keys,
+                    k, v) for k, v in self._values.items()]
+            if self.kind == "counter":
+                self._values.clear()  # counters push deltas
+        return out
+
+
+class Counter(Metric):
+    """Monotonic count (ref: util/metrics.py Counter)."""
+
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("Counter.inc() value must be >= 0")
+        key = _tags_key(self._merged(tags), self.tag_keys)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    """Last-written value (ref: util/metrics.py Gauge)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tags_key(self._merged(tags), self.tag_keys)
+        with self._lock:
+            self._values[key] = float(value)
+
+
+DEFAULT_BOUNDARIES = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                      2.5, 5.0, 10.0)
+
+
+class Histogram(Metric):
+    """Bucketed observations (ref: util/metrics.py Histogram)."""
+
+    kind = "histogram"
+    _registers_in_base = False  # registers below, after _hist exists
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = DEFAULT_BOUNDARIES,
+                 tag_keys: Sequence[str] = ()):
+        if not boundaries or list(boundaries) != sorted(boundaries):
+            raise ValueError("boundaries must be sorted and non-empty")
+        super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(boundaries)
+        # per tag-key: [bucket counts..., +inf count, sum, n]
+        self._hist: Dict[Tuple[str, ...], List[float]] = {}
+        self._register()
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None):
+        key = _tags_key(self._merged(tags), self.tag_keys)
+        with self._lock:
+            h = self._hist.get(key)
+            if h is None:
+                h = self._hist[key] = [0.0] * (len(self.boundaries) + 3)
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    h[i] += 1
+                    break
+            else:
+                h[len(self.boundaries)] += 1
+            h[-2] += value
+            h[-1] += 1
+
+    def _snapshot(self) -> List[tuple]:
+        with self._lock:
+            out = [("histogram", self.name, self.description,
+                    (self.tag_keys, self.boundaries), k, list(v))
+                   for k, v in self._hist.items()]
+            self._hist.clear()  # histograms push deltas
+        return out
+
+
+# ------------------------------------------------------------- transport
+
+
+def _ensure_pusher():
+    global _pusher_started
+    with _registry_lock:
+        if _pusher_started:
+            return
+        _pusher_started = True
+    t = threading.Thread(target=_push_loop, daemon=True,
+                         name="metrics-pusher")
+    t.start()
+
+
+def _push_loop():
+    from .core import protocol as P
+    from .core.context import get_context_if_exists
+
+    while True:
+        time.sleep(_FLUSH_PERIOD_S)
+        ctx = get_context_if_exists()
+        if ctx is None:
+            continue
+        with _registry_lock:
+            metrics = list(_registry)
+        batch: List[tuple] = []
+        for m in metrics:
+            batch.extend(m._snapshot())
+        if not batch:
+            continue
+        try:
+            ctx.head.send(P.METRICS_REPORT, batch)
+        except Exception:  # noqa: BLE001 — shutdown race
+            pass
+
+
+def flush_now():
+    """Push pending metric deltas immediately (tests / shutdown)."""
+    from .core import protocol as P
+    from .core.context import get_context_if_exists
+
+    ctx = get_context_if_exists()
+    if ctx is None:
+        return
+    with _registry_lock:
+        metrics = list(_registry)
+    batch: List[tuple] = []
+    for m in metrics:
+        batch.extend(m._snapshot())
+    if batch:
+        ctx.head.send(P.METRICS_REPORT, batch)
+
+
+# ------------------------------------------------------------ query side
+
+
+def metrics_summary() -> List[dict]:
+    """Cluster-merged metric rows from the head."""
+    from .core import protocol as P
+    from .core.context import get_context
+
+    (rows,) = get_context().head.call(P.STATE_QUERY, "metrics", 100000,
+                                      timeout=30)
+    return rows
+
+
+def export_prometheus() -> str:
+    """Render the head's metric table in Prometheus text exposition
+    format (the reference exports via opencensus -> prometheus)."""
+    lines: List[str] = []
+    for row in metrics_summary():
+        name = row["name"].replace(".", "_")
+        tags = row["tags"]
+        label = ",".join(f'{k}="{v}"' for k, v in tags.items())
+        label = "{" + label + "}" if label else ""
+        if row["kind"] == "histogram":
+            h = row["value"]
+            bounds = row["boundaries"]
+            acc = 0.0
+            for b, c in zip(list(bounds) + ["+Inf"], h[:-2]):
+                acc += c
+                lb = dict(tags, le=str(b))
+                ls = ",".join(f'{k}="{v}"' for k, v in lb.items())
+                lines.append(f"{name}_bucket{{{ls}}} {acc:g}")
+            lines.append(f"{name}_sum{label} {h[-2]:g}")
+            lines.append(f"{name}_count{label} {h[-1]:g}")
+        else:
+            lines.append(f"{name}{label} {row['value']:g}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,170 @@
+"""Runtime environments: per-job/task/actor execution environment.
+
+Ref parity: ray runtime_env (python/ray/_private/runtime_env/ — the agent
+at runtime_env_agent.py:159 materializes env_vars / working_dir /
+py_modules / pip per task). Re-designed for a pre-baked TPU image:
+
+- ``env_vars``: dict applied around task execution (saved/restored).
+- ``working_dir``: a local directory, packed and shipped through the
+  head KV store (the reference uploads to GCS the same way); workers
+  extract once per content digest and chdir into it for the task.
+- ``py_modules``: list of local package dirs shipped the same way and
+  prepended to sys.path.
+- ``pip`` / ``conda``: rejected with a clear error — this environment is
+  a sealed image with no package index; dependencies must be pre-baked
+  (matching how TPU pod images are operated).
+
+Size cap: packed archives ride the control-plane KV, so each is capped
+(default 64 MiB) — big data belongs in the object store, not the env.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import zipfile
+from typing import Any, Dict, List, Optional
+
+MAX_ARCHIVE_BYTES = 64 * 1024 * 1024
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+KV_NS = "runtime_env"
+
+
+def validate(env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    if not env:
+        return None
+    known = {"env_vars", "working_dir", "py_modules", "pip", "conda"}
+    unknown = set(env) - known
+    if unknown:
+        raise ValueError(f"unknown runtime_env keys: {sorted(unknown)}")
+    if env.get("pip") or env.get("conda"):
+        raise ValueError(
+            "runtime_env pip/conda are not supported on this sealed image "
+            "(no package index at runtime); pre-bake dependencies into "
+            "the image instead")
+    ev = env.get("env_vars")
+    if ev is not None and not (
+            isinstance(ev, dict) and
+            all(isinstance(k, str) and isinstance(v, str)
+                for k, v in ev.items())):
+        raise ValueError("env_vars must be a Dict[str, str]")
+    return env
+
+
+def _pack_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+            for f in files:
+                full = os.path.join(root, f)
+                zf.write(full, os.path.relpath(full, path))
+    blob = buf.getvalue()
+    if len(blob) > MAX_ARCHIVE_BYTES:
+        raise ValueError(
+            f"runtime_env directory {path!r} packs to "
+            f"{len(blob) >> 20} MiB (cap {MAX_ARCHIVE_BYTES >> 20} MiB); "
+            f"ship large data through the object store instead")
+    return blob
+
+
+def upload(ctx, env: Dict[str, Any]) -> Dict[str, Any]:
+    """Driver side: pack local dirs into the head KV, rewrite the env to
+    digest URIs (reference: working_dir upload + GCS URIs)."""
+    out = dict(env)
+    for key in ("working_dir", "py_modules"):
+        val = env.get(key)
+        if not val:
+            continue
+        paths: List[str] = [val] if isinstance(val, str) else list(val)
+        uris = []
+        for p in paths:
+            if p.startswith("kv://"):
+                uris.append(p)
+                continue
+            if not os.path.isdir(p):
+                raise ValueError(f"runtime_env {key}: {p!r} is not a "
+                                 f"directory")
+            blob = _pack_dir(p)
+            digest = hashlib.sha256(blob).hexdigest()[:16]
+            uri = f"kv://{digest}"
+            ctx.kv_put(KV_NS, digest, blob, overwrite=False)
+            uris.append(uri)
+        out[key] = uris[0] if key == "working_dir" else uris
+    return out
+
+
+def _materialize(ctx, uri: str) -> str:
+    """Worker side: fetch + extract an archive once per digest."""
+    digest = uri[len("kv://"):]
+    dest = os.path.join(ctx.session_dir, "runtime_envs", digest)
+    if os.path.isdir(dest):
+        return dest
+    blob = ctx.kv_get(KV_NS, digest)
+    if blob is None:
+        raise ValueError(f"runtime_env archive {uri} not found in KV")
+    # per-process tmp dir: concurrent workers materializing the same
+    # digest must not extract into one shared staging path
+    tmp = f"{dest}.tmp.{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+        zf.extractall(tmp)
+    try:
+        os.rename(tmp, dest)
+    except OSError:  # raced with another worker — theirs is identical
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+class applied:
+    """Context manager applying a runtime_env around one task execution
+    (the reference applies per worker-process; our workers are pooled
+    per scheduling class, so env application is scoped to the task)."""
+
+    def __init__(self, ctx, env: Optional[Dict[str, Any]]):
+        self._ctx = ctx
+        self._env = env or {}
+        self._saved_environ: Dict[str, Optional[str]] = {}
+        self._saved_cwd: Optional[str] = None
+        self._added_paths: List[str] = []
+
+    def __enter__(self):
+        env = self._env
+        for k, v in (env.get("env_vars") or {}).items():
+            self._saved_environ[k] = os.environ.get(k)
+            os.environ[k] = v
+        wd = env.get("working_dir")
+        if wd:
+            path = _materialize(self._ctx, wd)
+            self._saved_cwd = os.getcwd()
+            os.chdir(path)
+            sys.path.insert(0, path)
+            self._added_paths.append(path)
+        for uri in env.get("py_modules") or []:
+            path = _materialize(self._ctx, uri)
+            sys.path.insert(0, path)
+            self._added_paths.append(path)
+        return self
+
+    def __exit__(self, *exc):
+        for p in self._added_paths:
+            try:
+                sys.path.remove(p)
+            except ValueError:
+                pass
+        if self._saved_cwd is not None:
+            try:
+                os.chdir(self._saved_cwd)
+            except OSError:
+                pass
+        for k, old in self._saved_environ.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        return False

@@ -1,0 +1,79 @@
+"""Tracing: chrome-trace timeline export + user span annotations.
+
+Ref parity: ray.timeline() (python/ray/_private/state.py chrome_tracing_dump
+— every task becomes a chrome trace event laid out by worker lane) and the
+span annotations of ray.util.tracing (tracing_helper.py; the reference
+wraps task entry/exit in OpenTelemetry spans). Spans here ride the same
+task-event channel the state API uses — no OpenTelemetry dependency; the
+produced JSON loads in chrome://tracing / Perfetto.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+from .core import protocol as P
+from .core.context import get_context
+
+SPAN_START = "SPAN_START"
+SPAN_END = "SPAN_END"
+
+
+@contextmanager
+def span(name: str):
+    """Annotate a code region; it appears as a lane event in timeline().
+
+    Usable in the driver or inside tasks/actors::
+
+        with ray_tpu.tracing.span("preprocess"):
+            ...
+    """
+    ctx = get_context()
+    span_id = uuid.uuid4().hex[:16]
+    ctx.events.record(span_id, name, SPAN_START)
+    try:
+        yield
+    finally:
+        ctx.events.record(span_id, name, SPAN_END)
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Cluster timeline as chrome-trace events (ref: ray.timeline()).
+
+    Task RUNNING->FINISHED/FAILED pairs and span START->END pairs become
+    complete ("X") events; pid = node, tid = worker. Returns the event
+    list; also writes JSON when ``filename`` is given."""
+    ctx = get_context()
+    ctx.events.flush()
+    time.sleep(0.05)  # let the head ingest the tail of the batch
+    (rows,) = ctx.head.call(P.STATE_QUERY, "task_events", 1_000_000,
+                            timeout=30)
+    open_at: Dict[str, dict] = {}
+    events: List[Dict[str, Any]] = []
+    for r in sorted(rows, key=lambda r: r["ts"]):
+        state = r["state"]
+        if state in ("RUNNING", SPAN_START):
+            open_at[r["task_id"]] = r
+        elif state in ("FINISHED", "FAILED", SPAN_END):
+            start = open_at.pop(r["task_id"], None)
+            if start is None:
+                continue
+            events.append({
+                "name": r["name"],
+                "cat": "span" if state == SPAN_END else "task",
+                "ph": "X",
+                "ts": start["ts"] * 1e6,           # chrome wants usec
+                "dur": max(r["ts"] - start["ts"], 0) * 1e6,
+                "pid": f"node{start['node_idx']}",
+                "tid": f"worker:{start['worker_id'][:8]}",
+                "args": ({"error": r["error"]} if state == "FAILED"
+                         else {}),
+            })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

@@ -1,0 +1,138 @@
+"""Observability plane: metrics, tracing/timeline, hung-node eviction.
+
+Analogs of the reference's python/ray/tests/test_metrics_agent.py
+(util.metrics -> exporter), test_global_state.py::test_timeline
+(chrome-trace dump), and the GCS health-check manager behavior
+(src/ray/gcs/gcs_server/gcs_health_check_manager.h:39 — a wedged raylet
+is evicted by probe failures even though its socket stays open).
+"""
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import metrics, tracing
+
+
+def test_counter_gauge_merge(ray_start):
+    c = metrics.Counter("req.count", tag_keys=("route",))
+    c.inc(2.0, tags={"route": "/a"})
+    c.inc(3.0, tags={"route": "/a"})
+    c.inc(1.0, tags={"route": "/b"})
+    g = metrics.Gauge("queue.depth")
+    g.set(7.0)
+    g.set(4.0)
+    metrics.flush_now()
+    time.sleep(0.2)
+
+    rows = {(r["name"], tuple(sorted(r["tags"].items()))): r
+            for r in metrics.metrics_summary()}
+    assert rows[("req.count", (("route", "/a"),))]["value"] == 5.0
+    assert rows[("req.count", (("route", "/b"),))]["value"] == 1.0
+    assert rows[("queue.depth", ())]["value"] == 4.0
+
+    # counters keep accumulating across flushes (deltas merge head-side)
+    c.inc(5.0, tags={"route": "/a"})
+    metrics.flush_now()
+    time.sleep(0.2)
+    rows = {(r["name"], tuple(sorted(r["tags"].items()))): r
+            for r in metrics.metrics_summary()}
+    assert rows[("req.count", (("route", "/a"),))]["value"] == 10.0
+
+
+def test_histogram_and_prometheus_export(ray_start):
+    h = metrics.Histogram("latency.s", boundaries=(0.1, 1.0))
+    for v in (0.05, 0.5, 0.7, 5.0):
+        h.observe(v)
+    metrics.flush_now()
+    time.sleep(0.2)
+    row = next(r for r in metrics.metrics_summary()
+               if r["name"] == "latency.s")
+    counts = row["value"]
+    assert counts[:3] == [1.0, 2.0, 1.0]   # <=0.1, <=1.0, +inf
+    assert counts[-1] == 4.0               # n
+    assert abs(counts[-2] - 6.25) < 1e-9   # sum
+
+    text = metrics.export_prometheus()
+    assert 'latency_s_bucket{le="0.1"} 1' in text
+    assert "latency_s_count 4" in text
+
+
+def test_metrics_from_workers(ray_start):
+    @ray_tpu.remote
+    def work(i):
+        from ray_tpu import metrics as m
+
+        c = m.Counter("tasks.done")
+        c.inc()
+        m.flush_now()
+        return i
+
+    ray_tpu.get([work.remote(i) for i in range(4)], timeout=60)
+    time.sleep(0.3)
+    row = next((r for r in metrics.metrics_summary()
+                if r["name"] == "tasks.done"), None)
+    assert row is not None and row["value"] == 4.0
+
+
+def test_timeline_and_spans(ray_start, tmp_path):
+    @ray_tpu.remote
+    def traced_work():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([traced_work.remote() for _ in range(3)], timeout=60)
+    with tracing.span("driver-section"):
+        time.sleep(0.02)
+
+    out = str(tmp_path / "timeline.json")
+    deadline = time.monotonic() + 10
+    events = []
+    while time.monotonic() < deadline:
+        events = tracing.timeline(out)
+        if sum(1 for e in events if e["name"] == "traced_work") >= 3 and \
+                any(e["cat"] == "span" for e in events):
+            break
+        time.sleep(0.3)
+    tasks = [e for e in events if e["name"] == "traced_work"]
+    assert len(tasks) == 3
+    assert all(e["ph"] == "X" and e["dur"] >= 0.04e6 for e in tasks)
+    spans = [e for e in events if e["cat"] == "span"]
+    assert spans and spans[0]["name"] == "driver-section"
+    with open(out) as f:
+        assert json.load(f)  # valid chrome-trace JSON
+
+
+def test_hung_agent_is_evicted():
+    """SIGSTOP the agent (socket stays open, process wedged): only the
+    periodic probe can detect and evict it."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 1, "num_tpus": 0,
+        "_system_config": {"health_check_period_s": 0.3,
+                           "health_check_failure_threshold": 3}})
+    handle = None
+    try:
+        handle = cluster.add_remote_node(num_cpus=1)
+        assert len(ray_tpu.nodes()) == 2
+        os.kill(handle.proc.pid, signal.SIGSTOP)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if len([n for n in ray_tpu.nodes() if n["alive"]]) == 1:
+                break
+            time.sleep(0.3)
+        alive = [n for n in ray_tpu.nodes() if n["alive"]]
+        assert len(alive) == 1, "wedged agent was not evicted"
+    finally:
+        if handle is not None:
+            try:
+                os.kill(handle.proc.pid, signal.SIGCONT)
+            except ProcessLookupError:
+                pass
+            handle.terminate()
+        cluster.shutdown()

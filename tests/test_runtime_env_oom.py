@@ -1,0 +1,121 @@
+"""Runtime environments + OOM memory monitor.
+
+Analogs of the reference's python/ray/tests/test_runtime_env*.py
+(env_vars/working_dir/py_modules materialization per task) and
+test_memory_pressure.py (memory monitor kills the newest retriable
+task, which then retries — memory_monitor.h:52,
+worker_killing_policy.cc retriable-LIFO).
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime_env import validate
+
+
+def test_validate_rejects_pip_and_unknown():
+    with pytest.raises(ValueError, match="pre-bake"):
+        validate({"pip": ["requests"]})
+    with pytest.raises(ValueError, match="unknown"):
+        validate({"bogus_key": 1})
+    with pytest.raises(ValueError, match="env_vars"):
+        validate({"env_vars": {"A": 1}})
+    assert validate({}) is None
+    assert validate({"env_vars": {"A": "b"}}) == {"env_vars": {"A": "b"}}
+
+
+def test_env_vars_applied_and_restored(ray_start):
+    @ray_tpu.remote
+    def read_flag():
+        return os.environ.get("MY_FLAG")
+
+    with_env = read_flag.options(
+        runtime_env={"env_vars": {"MY_FLAG": "on"}})
+    assert ray_tpu.get(with_env.remote(), timeout=60) == "on"
+    # same scheduling class -> same pooled workers: the env must be
+    # RESTORED after the task, not leak into later plain tasks
+    assert ray_tpu.get(read_flag.remote(), timeout=60) is None
+
+
+def test_working_dir_shipped(ray_start, tmp_path):
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "data.txt").write_text("payload-42")
+    (proj / "helper_mod_xyz.py").write_text("VALUE = 1234\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
+    def use_dir():
+        import helper_mod_xyz  # importable from the shipped dir
+
+        with open("data.txt") as f:  # cwd is the shipped dir
+            return f.read(), helper_mod_xyz.VALUE
+
+    text, val = ray_tpu.get(use_dir.remote(), timeout=60)
+    assert text == "payload-42" and val == 1234
+
+
+def test_actor_runtime_env_persists(ray_start):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_ENV": "yes"}})
+    class EnvActor:
+        def read(self):
+            return os.environ.get("ACTOR_ENV")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.read.remote(), timeout=60) == "yes"
+    assert ray_tpu.get(a.read.remote(), timeout=60) == "yes"  # persists
+
+
+def test_job_level_runtime_env(tmp_path):
+    ray_tpu.init(num_cpus=2, num_tpus=0,
+                 runtime_env={"env_vars": {"JOB_WIDE": "1"}})
+    try:
+        @ray_tpu.remote
+        def read():
+            return os.environ.get("JOB_WIDE")
+
+        assert ray_tpu.get(read.remote(), timeout=60) == "1"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_memory_monitor_kills_newest_and_task_retries(ray_start):
+    """Fake memory pressure: the newest busy worker is killed; its task
+    retries and completes."""
+    from ray_tpu.core.api import _head
+    from ray_tpu.core.memory_monitor import MemoryMonitor
+
+    pressure = {"on": False}
+    mon = MemoryMonitor(_head, usage_fn=lambda: 0.99 if pressure["on"]
+                        else 0.1, period_s=0.05, threshold=0.95)
+    mon.start()
+    try:
+        @ray_tpu.remote(max_retries=2)
+        def slow(i):
+            time.sleep(2.0)
+            return i
+
+        refs = [slow.remote(i) for i in range(2)]
+        time.sleep(0.5)  # let tasks start running
+        pressure["on"] = True
+        deadline = time.monotonic() + 10
+        while mon.kills == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        pressure["on"] = False  # exactly one kill (cooldown covers rest)
+        assert mon.kills == 1
+        # the killed task must RETRY and still produce its result
+        assert ray_tpu.get(refs, timeout=120) == [0, 1]
+    finally:
+        mon.stop()
+
+
+def test_memory_monitor_no_victim_without_busy_workers(ray_start):
+    from ray_tpu.core.api import _head
+    from ray_tpu.core.memory_monitor import MemoryMonitor
+
+    mon = MemoryMonitor(_head, usage_fn=lambda: 0.99, period_s=0,
+                        threshold=0.95)
+    mon.check_once()  # no busy workers -> no kill, no crash
+    assert mon.kills == 0
