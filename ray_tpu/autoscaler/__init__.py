@@ -1,0 +1,15 @@
+"""Autoscaler: resource-demand-driven node scaling over a provider.
+
+Ref parity: the reference's StandardAutoscaler
+(python/ray/autoscaler/_private/autoscaler.py:166 update() loop over a
+NodeProvider, resource_demand_scheduler.py bin-packing of pending demand,
+idle-node termination). TPU re-design: nodes are whole hosts joining over
+TCP (node agents); bin-packing is simpler because TPU fleets are
+homogeneous per node type.
+"""
+
+from ray_tpu.autoscaler.autoscaler import (Autoscaler, AutoscalingPolicy,
+                                           LocalNodeProvider, NodeProvider)
+
+__all__ = ["Autoscaler", "AutoscalingPolicy", "NodeProvider",
+           "LocalNodeProvider"]

@@ -22,7 +22,7 @@ import sys
 import threading
 import time
 import uuid
-from typing import Dict
+from typing import Dict, Optional
 
 from . import protocol as P
 from .config import get_config
@@ -58,6 +58,12 @@ class NodeAgent:
         self.workers: Dict[str, subprocess.Popen] = {}
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
+        # None until REGISTER_NODE's reply lands. The head may race a
+        # SPAWN_WORKER onto the socket ahead of that reply (its keeper
+        # thread fulfills queued leases the moment the node appears in
+        # its tables); those spawns buffer here instead of being dropped.
+        self.node_idx: Optional[int] = None
+        self._pre_registration_spawns: list = []
 
         nr = detect_node_resources(num_cpus=num_cpus, num_tpus=num_tpus,
                                    object_store_memory=cap,
@@ -79,7 +85,13 @@ class NodeAgent:
         reply = self.head.call(P.REGISTER_NODE, nr, self.store_name,
                                self.node_ip, self.session_dir,
                                self.transfer_server.addr, timeout=30)
-        self.node_idx, self.session_name = reply[0], reply[1]
+        self.session_name = reply[1]
+        with self._lock:
+            self.node_idx = reply[0]
+            buffered, self._pre_registration_spawns = \
+                self._pre_registration_spawns, []
+        for worker_id in buffered:
+            self._spawn_worker(worker_id)
         # Tail THIS host's worker logs and publish them through the head's
         # "logs" channel so remote tasks' prints reach the driver too
         # (reference: one log_monitor per node, log_monitor.py:103).
@@ -110,6 +122,10 @@ class NodeAgent:
         mt, rid = msg[0], msg[1]
         try:
             if mt == P.SPAWN_WORKER:
+                with self._lock:
+                    if self.node_idx is None:
+                        self._pre_registration_spawns.append(msg[2])
+                        return
                 self._spawn_worker(msg[2])
             elif mt == P.KILL_WORKER:
                 self._kill_worker(msg[2])

@@ -1,0 +1,147 @@
+"""Dashboard: HTTP JSON state API + a minimal live HTML overview.
+
+Ref parity: the reference dashboard head (python/ray/dashboard/head.py:81)
+serving the REST endpoints its UI and `ray list ...` tooling consume.
+Re-design: one stdlib ThreadingHTTPServer in the driver/head process,
+reading the same head tables the state API uses — no aiohttp, no separate
+agent processes. Endpoints:
+
+    /api/nodes /api/workers /api/actors /api/tasks /api/objects
+    /api/placement_groups   -> state API rows (JSON)
+    /api/cluster            -> resource totals/availability
+    /api/jobs               -> submitted jobs (jobs.py)
+    /api/metrics            -> merged metric rows (JSON)
+    /metrics                -> Prometheus text exposition
+    /                       -> auto-refreshing HTML overview
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import ray_tpu
+
+_INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>body{font-family:monospace;margin:2em}table{border-collapse:collapse}
+td,th{border:1px solid #999;padding:4px 8px;text-align:left}</style></head>
+<body><h2>ray_tpu cluster</h2><div id=cluster></div>
+<h3>nodes</h3><table id=nodes></table>
+<h3>actors</h3><table id=actors></table>
+<h3>recent tasks</h3><table id=tasks></table>
+<script>
+async function fill(id, url, cols) {
+  const rows = await (await fetch(url)).json();
+  const t = document.getElementById(id);
+  t.innerHTML = '<tr>' + cols.map(c => '<th>'+c+'</th>').join('') + '</tr>' +
+    rows.slice(0, 50).map(r => '<tr>' + cols.map(
+      c => '<td>' + JSON.stringify(r[c] ?? '') + '</td>').join('') +
+      '</tr>').join('');
+}
+async function refresh() {
+  const c = await (await fetch('/api/cluster')).json();
+  document.getElementById('cluster').textContent = JSON.stringify(c);
+  await fill('nodes', '/api/nodes',
+             ['node_idx','alive','resources_total','resources_available']);
+  await fill('actors', '/api/actors',
+             ['actor_id','class_name','name','state']);
+  await fill('tasks', '/api/tasks', ['task_id','name','state','node_idx']);
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "ray_tpu-dashboard"
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj, code: int = 200):
+        self._send(code, json.dumps(obj, default=str).encode(),
+                   "application/json")
+
+    def do_GET(self):  # noqa: N802 - stdlib API
+        from ray_tpu import metrics, state
+
+        path = self.path.split("?")[0].rstrip("/") or "/"
+        try:
+            if path == "/":
+                self._send(200, _INDEX_HTML.encode(), "text/html")
+            elif path == "/api/cluster":
+                self._json({
+                    "nodes": len(ray_tpu.nodes()),
+                    "resources_total": ray_tpu.cluster_resources(),
+                    "resources_available": ray_tpu.available_resources(),
+                })
+            elif path == "/api/jobs":
+                from ray_tpu.jobs import JOB_MANAGER_NAME
+
+                try:
+                    mgr = ray_tpu.get_actor(JOB_MANAGER_NAME)
+                    self._json(ray_tpu.get(mgr.list.remote(), timeout=10))
+                except Exception:  # noqa: BLE001 — no jobs submitted yet
+                    self._json([])
+            elif path == "/api/metrics":
+                self._json(metrics.metrics_summary())
+            elif path == "/metrics":
+                self._send(200, metrics.export_prometheus().encode(),
+                           "text/plain; version=0.0.4")
+            elif path.startswith("/api/"):
+                kind = path[len("/api/"):]
+                fn = {
+                    "nodes": state.list_nodes,
+                    "workers": state.list_workers,
+                    "actors": state.list_actors,
+                    "tasks": state.list_tasks,
+                    "objects": state.list_objects,
+                    "placement_groups": state.list_placement_groups,
+                }.get(kind)
+                if fn is None:
+                    self._json({"error": f"unknown endpoint {path}"}, 404)
+                else:
+                    self._json(fn(limit=1000))
+            else:
+                self._json({"error": "not found"}, 404)
+        except Exception as e:  # noqa: BLE001 — surface as 500
+            self._json({"error": repr(e)}, 500)
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "Dashboard":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="dashboard")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> Dashboard:
+    """Start the dashboard against the current runtime; returns the
+    handle (``.url``, ``.stop()``). Port 0 picks a free port."""
+    if not ray_tpu.is_initialized():
+        raise RuntimeError("call ray_tpu.init() before start_dashboard()")
+    return Dashboard(host, port).start()

@@ -1,0 +1,204 @@
+"""Job submission: run driver scripts on the cluster with tracked status.
+
+Ref parity: ray job submission (python/ray/dashboard/modules/job/
+job_manager.py:517 JobManager.submit_job — entrypoint subprocess with
+RAY_ADDRESS injected, status machine PENDING -> RUNNING -> SUCCEEDED/
+FAILED/STOPPED, logs captured per job; python/ray/job_submission/
+JobSubmissionClient). Re-design: the manager is a named detached actor on
+the cluster (so remote clients reach it through the normal actor path and
+job state survives the submitting client), spawning entrypoint
+subprocesses next to the head with RAY_TPU_ADDRESS injected.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+JOB_MANAGER_NAME = "_ray_tpu_job_manager"
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+STOPPED = "STOPPED"
+
+
+class _JobManager:
+    """Named actor owning job subprocesses + their status table."""
+
+    def __init__(self, head_addr: str, log_dir: str):
+        self._head_addr = head_addr
+        self._log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._jobs: Dict[str, dict] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def submit(self, entrypoint: str, submission_id: Optional[str],
+               env_vars: Optional[Dict[str, str]],
+               metadata: Optional[Dict[str, str]]) -> str:
+        job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
+        with self._lock:
+            if job_id in self._jobs:
+                raise ValueError(f"job {job_id!r} already exists")
+            self._jobs[job_id] = {
+                "job_id": job_id, "entrypoint": entrypoint,
+                "status": PENDING, "submitted_at": time.time(),
+                "started_at": None, "ended_at": None,
+                "metadata": metadata or {}, "message": "",
+            }
+        env = dict(os.environ)
+        env.update(env_vars or {})
+        # the entrypoint attaches to THIS cluster (ref: RAY_ADDRESS)
+        env["RAY_TPU_ADDRESS"] = self._head_addr
+        log_path = os.path.join(self._log_dir, f"{job_id}.log")
+        try:
+            with open(log_path, "ab") as logf:
+                proc = subprocess.Popen(
+                    entrypoint, shell=True, env=env, stdout=logf,
+                    stderr=subprocess.STDOUT, start_new_session=True)
+        except OSError as e:
+            with self._lock:
+                self._jobs[job_id].update(status=FAILED, message=repr(e),
+                                          ended_at=time.time())
+            return job_id
+        with self._lock:
+            self._procs[job_id] = proc
+            self._jobs[job_id].update(status=RUNNING,
+                                      started_at=time.time())
+        threading.Thread(target=self._wait, args=(job_id, proc),
+                         daemon=True).start()
+        return job_id
+
+    def _wait(self, job_id: str, proc: subprocess.Popen):
+        rc = proc.wait()
+        with self._lock:
+            info = self._jobs.get(job_id)
+            if info is None or info["status"] == STOPPED:
+                return
+            info["status"] = SUCCEEDED if rc == 0 else FAILED
+            info["message"] = f"exit code {rc}"
+            info["ended_at"] = time.time()
+
+    def status(self, job_id: str) -> dict:
+        with self._lock:
+            info = self._jobs.get(job_id)
+            if info is None:
+                raise ValueError(f"no such job: {job_id}")
+            return dict(info)
+
+    def list(self) -> List[dict]:
+        with self._lock:
+            return [dict(v) for v in self._jobs.values()]
+
+    def logs(self, job_id: str) -> str:
+        self.status(job_id)  # raises on unknown id
+        path = os.path.join(self._log_dir, f"{job_id}.log")
+        try:
+            with open(path, errors="replace") as f:
+                return f.read()
+        except OSError:
+            return ""
+
+    def stop(self, job_id: str) -> bool:
+        with self._lock:
+            info = self._jobs.get(job_id)
+            proc = self._procs.get(job_id)
+            if info is None:
+                raise ValueError(f"no such job: {job_id}")
+            if info["status"] != RUNNING or proc is None:
+                return False
+            info["status"] = STOPPED
+            info["ended_at"] = time.time()
+        try:
+            os.killpg(os.getpgid(proc.pid), 15)  # the job's process group
+        except (ProcessLookupError, PermissionError):
+            pass
+        return True
+
+    def delete(self, job_id: str) -> bool:
+        with self._lock:
+            info = self._jobs.get(job_id)
+            if info is None:
+                return False
+            if info["status"] == RUNNING:
+                raise RuntimeError("stop the job before deleting it")
+            self._jobs.pop(job_id, None)
+            self._procs.pop(job_id, None)
+        return True
+
+
+class JobSubmissionClient:
+    """Ref parity: ray.job_submission.JobSubmissionClient (HTTP in the
+    reference; the named-actor path here serves the same surface)."""
+
+    def __init__(self, address: Optional[str] = None):
+        if address and not ray_tpu.is_initialized():
+            ray_tpu.init(address=address, log_to_driver=False)
+        elif not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._manager = _get_or_create_manager()
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        env_vars = (runtime_env or {}).get("env_vars")
+        return ray_tpu.get(self._manager.submit.remote(
+            entrypoint, submission_id, env_vars, metadata), timeout=60)
+
+    def get_job_status(self, job_id: str) -> str:
+        return ray_tpu.get(self._manager.status.remote(job_id),
+                           timeout=60)["status"]
+
+    def get_job_info(self, job_id: str) -> dict:
+        return ray_tpu.get(self._manager.status.remote(job_id), timeout=60)
+
+    def get_job_logs(self, job_id: str) -> str:
+        return ray_tpu.get(self._manager.logs.remote(job_id), timeout=60)
+
+    def list_jobs(self) -> List[dict]:
+        return ray_tpu.get(self._manager.list.remote(), timeout=60)
+
+    def stop_job(self, job_id: str) -> bool:
+        return ray_tpu.get(self._manager.stop.remote(job_id), timeout=60)
+
+    def delete_job(self, job_id: str) -> bool:
+        return ray_tpu.get(self._manager.delete.remote(job_id), timeout=60)
+
+    def tail_job_logs(self, job_id: str, poll_s: float = 0.5):
+        """Generator of new log text until the job finishes."""
+        seen = 0
+        while True:
+            text = self.get_job_logs(job_id)
+            if len(text) > seen:
+                yield text[seen:]
+                seen = len(text)
+            if self.get_job_status(job_id) not in (PENDING, RUNNING):
+                tail = self.get_job_logs(job_id)
+                if len(tail) > seen:
+                    yield tail[seen:]
+                return
+            time.sleep(poll_s)
+
+
+def _get_or_create_manager():
+    from ray_tpu.core.context import get_context
+
+    try:
+        return ray_tpu.get_actor(JOB_MANAGER_NAME)
+    except Exception:  # noqa: BLE001 — not created yet
+        ctx = get_context()
+        cls = ray_tpu.remote(_JobManager)
+        try:
+            return cls.options(name=JOB_MANAGER_NAME).remote(
+                ctx.head_addr, os.path.join(ctx.session_dir, "job_logs"))
+        except Exception:  # noqa: BLE001 — lost the creation race
+            return ray_tpu.get_actor(JOB_MANAGER_NAME)

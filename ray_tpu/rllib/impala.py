@@ -79,6 +79,10 @@ class IMPALA(Algorithm):
         while updates < cfg.max_updates_per_step:
             done, _ = ray_tpu.wait(list(self._inflight), num_returns=agg,
                                    timeout=600)
+            if len(done) < agg:
+                raise TimeoutError(
+                    f"IMPALA: only {len(done)}/{agg} rollouts completed "
+                    f"within 600s — rollout workers dead or stalled")
             batches = ray_tpu.get(list(done), timeout=600)
             workers_done = [self._inflight.pop(r) for r in done]
             merged = _concat_time_major(batches)

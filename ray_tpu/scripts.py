@@ -193,7 +193,131 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("entity", choices=["tasks", "actors", "objects"])
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_summary)
+
+    # ----- serve group (ref: the `serve` CLI, python/ray/serve/scripts.py)
+    sp = sub.add_parser("serve", help="model-serving commands")
+    serve_sub = sp.add_subparsers(dest="serve_cmd", required=True)
+    d = serve_sub.add_parser("deploy", help="deploy apps from a YAML/JSON "
+                                            "config")
+    d.add_argument("config_file")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_serve_deploy)
+    d = serve_sub.add_parser("run", help="import and run module:app")
+    d.add_argument("import_path")
+    d.add_argument("--name", default="default")
+    d.add_argument("--route-prefix", default=None)
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_serve_run)
+    d = serve_sub.add_parser("status", help="application status")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_serve_status)
+    d = serve_sub.add_parser("shutdown", help="tear down all serve apps")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_serve_shutdown)
+
+    # ----- job group (ref: `ray job`, dashboard/modules/job/cli.py)
+    sp = sub.add_parser("job", help="job submission commands")
+    job_sub = sp.add_subparsers(dest="job_cmd", required=True)
+    d = job_sub.add_parser("submit", help="run an entrypoint on the "
+                                          "cluster")
+    d.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    d.add_argument("--address")
+    d.add_argument("--submission-id")
+    d.set_defaults(fn=cmd_job_submit)
+    d = job_sub.add_parser("status")
+    d.add_argument("job_id")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_job_status)
+    d = job_sub.add_parser("logs")
+    d.add_argument("job_id")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_job_logs)
+    d = job_sub.add_parser("list")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_job_list)
+    d = job_sub.add_parser("stop")
+    d.add_argument("job_id")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_job_stop)
     return p
+
+
+def cmd_serve_deploy(args):
+    from ray_tpu import serve
+
+    _attached(args)
+    names = serve.deploy_config(args.config_file)
+    print(f"deployed applications: {names}")
+    return 0
+
+
+def cmd_serve_run(args):
+    from ray_tpu import serve
+    from ray_tpu.serve.schema import _import_target
+
+    _attached(args)
+    target = _import_target(args.import_path)
+    serve.run(target, name=args.name,
+              route_prefix=args.route_prefix or f"/{args.name}")
+    print(f"app '{args.name}' running")
+    return 0
+
+
+def cmd_serve_status(args):
+    from ray_tpu import serve
+
+    _attached(args)
+    print(json.dumps(serve.status(), indent=2, default=str))
+    return 0
+
+
+def cmd_serve_shutdown(args):
+    from ray_tpu import serve
+
+    _attached(args)
+    serve.shutdown()
+    print("serve shut down")
+    return 0
+
+
+def _job_client(args):
+    from ray_tpu.jobs import JobSubmissionClient
+
+    _attached(args)
+    return JobSubmissionClient()
+
+
+def cmd_job_submit(args):
+    entry = " ".join(args.entrypoint).lstrip("- ")
+    if not entry:
+        sys.exit("job submit needs an entrypoint, e.g. "
+                 "`job submit -- python my_script.py`")
+    client = _job_client(args)
+    jid = client.submit_job(entrypoint=entry,
+                            submission_id=args.submission_id)
+    print(jid)
+    return 0
+
+
+def cmd_job_status(args):
+    print(_job_client(args).get_job_status(args.job_id))
+    return 0
+
+
+def cmd_job_logs(args):
+    print(_job_client(args).get_job_logs(args.job_id), end="")
+    return 0
+
+
+def cmd_job_list(args):
+    print(json.dumps(_job_client(args).list_jobs(), indent=2,
+                     default=str))
+    return 0
+
+
+def cmd_job_stop(args):
+    print(_job_client(args).stop_job(args.job_id))
+    return 0
 
 
 def main(argv=None) -> int:

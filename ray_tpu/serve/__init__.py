@@ -30,11 +30,16 @@ from .api import (
 from .batching import batch
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
 from .deployment import Application, Deployment, deployment
-from .handle import DeploymentHandle, DeploymentResponse
+from .handle import (DeploymentHandle, DeploymentResponse,
+                     DeploymentResponseGenerator)
+from .multiplex import get_multiplexed_model_id, multiplexed
+from .schema import deploy_config
 
 __all__ = [
     "deployment", "Deployment", "Application", "run", "delete", "status",
     "shutdown", "start", "batch", "get_app_handle", "get_deployment_handle",
-    "DeploymentHandle", "DeploymentResponse", "AutoscalingConfig",
+    "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
+    "multiplexed", "get_multiplexed_model_id", "deploy_config",
+    "AutoscalingConfig",
     "DeploymentConfig", "HTTPOptions",
 ]

@@ -66,6 +66,28 @@ class HTTPProxy:
                         except json.JSONDecodeError:
                             arg = body.decode("utf-8", "replace")
                     handle = proxy._app_handle(app)
+                    if self.headers.get("X-Serve-Stream") == "1":
+                        # chunked ndjson streaming (ref: StreamingResponse
+                        # over a generator deployment, replica.py:339)
+                        gen = handle.options(stream=True).remote(arg)
+                        try:
+                            self.send_response(200)
+                            self.send_header("Content-Type",
+                                             "application/x-ndjson")
+                            self.send_header("Transfer-Encoding",
+                                             "chunked")
+                            self.end_headers()
+                            for item in gen:
+                                chunk = (json.dumps(item) + "\n").encode()
+                                self.wfile.write(
+                                    f"{len(chunk):x}\r\n".encode()
+                                    + chunk + b"\r\n")
+                            self.wfile.write(b"0\r\n\r\n")
+                        finally:
+                            # client disconnects mid-stream must not leak
+                            # the replica slot
+                            gen.close()
+                        return
                     result = handle.remote(arg).result(timeout_s=60)
                     if isinstance(result, bytes):
                         self._reply(200, result,

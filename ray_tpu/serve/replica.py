@@ -63,25 +63,92 @@ class ServeReplica:
 
     # ------------------------------------------------------------- serving
 
-    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+    def _resolve_fn(self, method_name: str):
+        if self._is_function:
+            return self._callable
+        if method_name == "__call__":
+            fn = self._callable
+            if not callable(fn):
+                raise TypeError(
+                    f"deployment class {type(self._callable).__name__} "
+                    "has no __call__; call a named method instead")
+            return fn
+        return getattr(self._callable, method_name)
+
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict,
+                       meta: dict = None):
+        from .multiplex import _set_request_model_id
+
         with self._lock:
             self._ongoing += 1
+        _set_request_model_id((meta or {}).get("multiplexed_model_id", ""))
         try:
-            if self._is_function:
-                fn = self._callable
-            elif method_name == "__call__":
-                fn = self._callable
-                if not callable(fn):
-                    raise TypeError(
-                        f"deployment class {type(self._callable).__name__} "
-                        "has no __call__; call a named method instead")
-            else:
-                fn = getattr(self._callable, method_name)
-            return fn(*args, **kwargs)
+            return self._resolve_fn(method_name)(*args, **kwargs)
         finally:
+            _set_request_model_id("")
             with self._lock:
                 self._ongoing -= 1
                 self._completed += 1
+
+    # ------------------------------------------------------- streaming
+
+    def start_stream(self, method_name: str, args: tuple, kwargs: dict,
+                     meta: dict = None) -> str:
+        """Begin a streaming response: run the (generator) callable, park
+        its iterator, return a stream id the client drains with
+        stream_next (ref: replica.py:339 streaming generator support).
+        The stream counts as one ongoing request until it ends."""
+        import uuid
+
+        from .multiplex import _set_request_model_id
+
+        _set_request_model_id((meta or {}).get("multiplexed_model_id", ""))
+        try:
+            result = self._resolve_fn(method_name)(*args, **kwargs)
+        finally:
+            _set_request_model_id("")
+        it = iter(result)
+        sid = uuid.uuid4().hex[:16]
+        with self._lock:
+            self._ongoing += 1
+            if not hasattr(self, "_streams"):
+                self._streams = {}
+            self._streams[sid] = it
+        return sid
+
+    def cancel_stream(self, sid: str):
+        """Abandoned stream (client gone): drop the parked iterator and
+        free its request slot."""
+        with self._lock:
+            it = getattr(self, "_streams", {}).pop(sid, None)
+            if it is not None:
+                self._ongoing -= 1
+                self._completed += 1
+        if it is not None and hasattr(it, "close"):
+            try:
+                it.close()
+            except Exception:  # noqa: BLE001 — generator cleanup
+                pass
+
+    def stream_next(self, sid: str, max_items: int = 8):
+        """-> (items, done). Pulls up to max_items from the stream."""
+        with self._lock:
+            it = getattr(self, "_streams", {}).get(sid)
+        if it is None:
+            raise KeyError(f"no such stream {sid}")
+        items = []
+        done = False
+        try:
+            for _ in range(max_items):
+                items.append(next(it))
+        except StopIteration:
+            done = True
+        if done:
+            with self._lock:
+                self._streams.pop(sid, None)
+                self._ongoing -= 1
+                self._completed += 1
+        return items, done
 
     # ---------------------------------------------------------- management
 

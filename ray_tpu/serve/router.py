@@ -33,6 +33,7 @@ class Router:
         self._version = -1
         self._last_refresh = 0.0
         self._outstanding: Dict[object, str] = {}  # ObjectRef -> replica_id
+        self._model_affinity: Dict[str, str] = {}  # model_id -> replica_id
         self._drainer: Optional[threading.Thread] = None
         self._controller = None
 
@@ -67,30 +68,59 @@ class Router:
     # ------------------------------------------------------------- dispatch
 
     def assign(self, method_name: str, args: tuple, kwargs: dict,
-               timeout_s: float = 60.0):
+               timeout_s: float = 60.0, meta: Optional[dict] = None):
         """Pick a replica (power of two choices) and push the request.
 
         Returns the resulting ObjectRef. Blocks while all replicas are at
-        max_concurrent_queries (client-side backpressure).
-        """
+        max_concurrent_queries (client-side backpressure)."""
+        rid, handle = self._acquire_replica(timeout_s, meta)
+        ref = None
+        try:
+            ref = handle.handle_request.remote(
+                method_name, args, kwargs, meta)
+            with self._lock:
+                self._outstanding[ref] = rid
+                self._ensure_drainer_locked()
+            return ref
+        finally:
+            if ref is None:  # submission itself failed
+                self.release(rid)
+
+    def assign_stream(self, method_name: str, args: tuple, kwargs: dict,
+                      timeout_s: float = 60.0,
+                      meta: Optional[dict] = None):
+        """Pick a replica for a STREAMING request. Returns (replica_id,
+        actor_handle, stream_id_ref); the caller drives stream_next and
+        MUST call release(replica_id) when the stream ends — the slot
+        stays held for the stream's whole lifetime."""
+        rid, handle = self._acquire_replica(timeout_s, meta)
+        try:
+            sid_ref = handle.start_stream.remote(
+                method_name, args, kwargs, meta)
+        except BaseException:
+            self.release(rid)
+            raise
+        return rid, handle, sid_ref
+
+    def release(self, rid: str):
+        with self._lock:
+            if rid in self._inflight:
+                self._inflight[rid] = max(0, self._inflight[rid] - 1)
+            self._cond.notify_all()
+
+    def _acquire_replica(self, timeout_s: float, meta: Optional[dict]):
         self._refresh()
+        model_id = (meta or {}).get("multiplexed_model_id", "")
         deadline = time.monotonic() + timeout_s
         while True:
             with self._lock:
-                choice = self._choose_locked()
+                choice = self._choose_locked(model_id)
                 if choice is not None:
                     rid, handle = choice
                     self._inflight[rid] = self._inflight.get(rid, 0) + 1
-                    ref = None
-                    try:
-                        ref = handle.handle_request.remote(
-                            method_name, args, kwargs)
-                        self._outstanding[ref] = rid
-                        self._ensure_drainer_locked()
-                        return ref
-                    finally:
-                        if ref is None:  # submission itself failed
-                            self._inflight[rid] -= 1
+                    if model_id:
+                        self._model_affinity[model_id] = rid
+                    return rid, handle
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise TimeoutError(
@@ -99,11 +129,20 @@ class Router:
                 self._cond.wait(min(remaining, _REFRESH_TTL_S))
             self._refresh(force=not self._replicas)
 
-    def _choose_locked(self) -> Optional[Tuple[str, object]]:
+    def _choose_locked(self, model_id: str = ""
+                       ) -> Optional[Tuple[str, object]]:
         avail = [(rid, h) for rid, h in self._replicas
                  if self._inflight.get(rid, 0) < self._max_q]
         if not avail:
             return None
+        if model_id:
+            # multiplexing affinity: prefer the replica that already holds
+            # the model, unless it is saturated (ref: multiplexed routing
+            # in the reference's replica scheduler)
+            want = self._model_affinity.get(model_id)
+            for rid, h in avail:
+                if rid == want:
+                    return rid, h
         if len(avail) == 1:
             return avail[0]
         a, b = random.sample(avail, 2)

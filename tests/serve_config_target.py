@@ -1,0 +1,12 @@
+"""Import target for the declarative-config serve test."""
+
+from ray_tpu import serve
+
+
+@serve.deployment
+class Echo:
+    def __call__(self, x):
+        return f"echo:{x}"
+
+
+app = Echo.bind()

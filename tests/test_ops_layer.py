@@ -1,0 +1,207 @@
+"""Ops layer: autoscaler, job submission, dashboard.
+
+Analogs of the reference's python/ray/tests/test_autoscaler.py
+(StandardAutoscaler.update against a mock provider + the real node-join
+path), dashboard/modules/job/tests/test_job_manager.py (submit/status/
+logs/stop lifecycle), and dashboard/tests (REST endpoints)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import Autoscaler, AutoscalingPolicy, NodeProvider
+
+
+class FakeProvider(NodeProvider):
+    """Mock provider (ref: test_autoscaler MockProvider)."""
+
+    def __init__(self):
+        self.nodes = {}
+        self.next = 0
+        self.num_cpus = 2
+
+    def create_node(self):
+        pid = f"fake-{self.next}"
+        self.next += 1
+        self.nodes[pid] = True
+        return pid
+
+    def terminate_node(self, pid):
+        self.nodes.pop(pid, None)
+
+    def non_terminated_nodes(self):
+        return list(self.nodes)
+
+
+class FakeHead:
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._pending_leases = []
+        self._pending_pg = []
+        self.nodes = {}
+
+    def remove_node(self, idx):
+        self.nodes.pop(idx, None)
+
+
+def test_autoscaler_scales_up_on_demand():
+    head = FakeHead()
+    provider = FakeProvider()
+    sc = Autoscaler(head, provider, AutoscalingPolicy(
+        max_workers=3, max_launch_batch=2))
+    head._pending_leases = [1, 2, 3]  # 3 unsatisfiable leases, 2 cpus/node
+    sc.update()
+    assert len(provider.non_terminated_nodes()) == 2  # ceil(3/2), batch cap
+    sc.update()
+    assert len(provider.non_terminated_nodes()) == 3  # capped by max_workers
+    sc.update()
+    assert len(provider.non_terminated_nodes()) == 3
+
+
+def test_autoscaler_respects_min_workers():
+    sc = Autoscaler(FakeHead(), FakeProvider(), AutoscalingPolicy(
+        min_workers=2, max_workers=4))
+    sc.update()
+    assert len(sc._provider.non_terminated_nodes()) == 2
+
+
+def test_autoscaler_real_node_joins_and_idles_away():
+    """Demand -> a REAL node agent launches and registers; idle ->
+    terminated (the reference's end-to-end scale-up/down loop)."""
+    from ray_tpu.autoscaler import LocalNodeProvider
+
+    # short lease keep-alive: scale-DOWN waits for the driver to return
+    # idle leased workers, which it holds 30s by default
+    info = ray_tpu.init(num_cpus=1, num_tpus=0, _system_config={
+        "idle_worker_keep_alive_s": 1.0})
+    try:
+        head = info.head
+        addr = head.enable_tcp(host="127.0.0.1", advertise_ip="127.0.0.1")
+        provider = LocalNodeProvider(addr, num_cpus_per_node=1)
+        sc = Autoscaler(head, provider, AutoscalingPolicy(
+            max_workers=1, idle_timeout_s=1.5, update_interval_s=0.2))
+        sc.start()
+        try:
+            # saturate the 1-cpu head node, forcing a queued lease
+            @ray_tpu.remote
+            def hold(t):
+                time.sleep(t)
+                return 1
+
+            refs = [hold.remote(3.0), hold.remote(3.0)]
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if len(ray_tpu.nodes()) == 2:
+                    break
+                time.sleep(0.2)
+            assert len(ray_tpu.nodes()) == 2, "no node launched"
+            assert ray_tpu.get(refs, timeout=60) == [1, 1]
+            # once idle past the timeout, the node is terminated
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if len(ray_tpu.nodes()) == 1:
+                    break
+                time.sleep(0.3)
+            assert len(ray_tpu.nodes()) == 1, "idle node not terminated"
+            assert sc.num_launches >= 1 and sc.num_terminations >= 1
+        finally:
+            sc.stop()
+            for pid in provider.non_terminated_nodes():
+                provider.terminate_node(pid)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_job_lifecycle(ray_start):
+    from ray_tpu.jobs import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint="python -c \"print('job says hello')\"",
+        metadata={"owner": "test"})
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if client.get_job_status(job_id) in ("SUCCEEDED", "FAILED"):
+            break
+        time.sleep(0.2)
+    assert client.get_job_status(job_id) == "SUCCEEDED"
+    assert "job says hello" in client.get_job_logs(job_id)
+    info = client.get_job_info(job_id)
+    assert info["metadata"]["owner"] == "test"
+    assert any(j["job_id"] == job_id for j in client.list_jobs())
+
+    failing = client.submit_job(entrypoint="python -c 'raise SystemExit(3)'")
+    while client.get_job_status(failing) == "RUNNING":
+        time.sleep(0.1)
+    assert client.get_job_status(failing) == "FAILED"
+    assert "exit code 3" in client.get_job_info(failing)["message"]
+
+    stoppable = client.submit_job(entrypoint="sleep 60")
+    time.sleep(0.3)
+    assert client.stop_job(stoppable)
+    assert client.get_job_status(stoppable) == "STOPPED"
+    with pytest.raises(Exception):
+        client.get_job_status("nonexistent-job")
+    assert client.delete_job(stoppable)
+
+
+def test_job_can_attach_to_cluster(ray_start):
+    """The entrypoint reaches THIS cluster via the injected address."""
+    from ray_tpu.jobs import JobSubmissionClient
+
+    script = (
+        "import os, sys; sys.path.insert(0, os.environ['JOB_REPO']);"
+        "import ray_tpu;"
+        "ray_tpu.init(address=os.environ['RAY_TPU_ADDRESS']);"
+        "print('cpus:', ray_tpu.cluster_resources()['CPU'])")
+    import ray_tpu as pkg
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(pkg.__file__)))
+    client = JobSubmissionClient()
+    jid = client.submit_job(
+        entrypoint=f'python -c "{script}"',
+        runtime_env={"env_vars": {"JOB_REPO": repo}})
+    out = "".join(client.tail_job_logs(jid))
+    assert client.get_job_status(jid) == "SUCCEEDED", out
+    assert "cpus: 4.0" in out
+
+
+def test_dashboard_endpoints(ray_start):
+    from ray_tpu.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get(noop.remote(), timeout=60)
+    dash = start_dashboard(port=0)
+    try:
+        def fetch(path):
+            try:
+                with urllib.request.urlopen(dash.url + path,
+                                            timeout=10) as r:
+                    return r.status, r.read()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read()
+
+        status, body = fetch("/api/cluster")
+        assert status == 200
+        assert json.loads(body)["resources_total"]["CPU"] == 4.0
+        status, body = fetch("/api/nodes")
+        assert json.loads(body)[0]["alive"] is True
+        status, body = fetch("/api/actors")
+        assert status == 200
+        status, body = fetch("/")
+        assert status == 200 and b"ray_tpu" in body
+        status, body = fetch("/metrics")
+        assert status == 200
+        status, body = fetch("/api/bogus")
+        assert status == 404
+    finally:
+        dash.stop()
