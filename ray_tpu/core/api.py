@@ -63,6 +63,31 @@ def init(*, num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
 
         reset_config()
         get_config().apply_overrides(_system_config)
+        if address and address.startswith("tcp:"):
+            # Remote driver (the reference's Ray Client, python/ray/util/
+            # client/ — but as a full peer): an in-process node agent
+            # joins the cluster over TCP, giving this host its own object
+            # store and worker pool; the driver then runs node-local with
+            # no proxying of object ops.
+            from .node_agent import NodeAgent
+
+            agent = NodeAgent(address, num_cpus=num_cpus or 0,
+                              num_tpus=num_tpus or 0)
+            threading.Thread(target=agent.run_forever, daemon=True,
+                             name="driver-node-agent").start()
+            os.environ["RAY_TPU_NODE_IP"] = agent.node_ip
+            try:
+                ctx = CoreContext(head_addr=address,
+                                  session_dir=agent.session_dir,
+                                  node_idx=agent.node_idx, is_driver=True)
+            finally:
+                os.environ.pop("RAY_TPU_NODE_IP", None)
+            ctx._local_agent = agent  # torn down with the context
+            set_context(ctx)
+            if log_to_driver:
+                _mirror_worker_logs(ctx)
+            _apply_job_runtime_env(ctx, runtime_env)
+            return RuntimeInfo(ctx, None)
         if address:
             session_dir = os.path.dirname(address.replace("unix:", ""))
             ctx = CoreContext(head_addr=address, session_dir=session_dir,

@@ -1571,6 +1571,12 @@ class CoreContext:
             self.head.close()
         except Exception:
             pass
+        agent = getattr(self, "_local_agent", None)
+        if agent is not None:  # remote-driver mode: our in-process node
+            try:
+                agent.shutdown()
+            except Exception:
+                pass
         self.io.stop()
         try:
             self._listener.close()

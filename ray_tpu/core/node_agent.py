@@ -267,10 +267,16 @@ def main(argv=None):
     ap.add_argument("--num-cpus", type=int, default=None)
     ap.add_argument("--num-tpus", type=int, default=None)
     ap.add_argument("--object-store-memory", type=int, default=None)
+    ap.add_argument("--label", action="append", default=[],
+                    metavar="KEY=VALUE",
+                    help="node label (repeatable; e.g. the autoscaler "
+                         "tags its launches to reclaim them later)")
     args = ap.parse_args(argv)
+    labels = dict(kv.split("=", 1) for kv in args.label)
     agent = NodeAgent(args.address, num_cpus=args.num_cpus,
                       num_tpus=args.num_tpus,
-                      object_store_memory=args.object_store_memory)
+                      object_store_memory=args.object_store_memory,
+                      labels=labels or None)
     print(f"node agent joined as node {agent.node_idx} "
           f"(store {agent.store_name})", flush=True)
     signal.signal(signal.SIGTERM, lambda *a: agent._shutdown.set())

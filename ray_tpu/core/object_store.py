@@ -218,6 +218,27 @@ class ShmObjectStore:
         self.seal(object_id)
         return total + len(meta)
 
+    def put_raw(self, object_id: ObjectID, data: bytes) -> int:
+        """Store raw bytes with NO metadata — the cross-language payload
+        convention shared with the C++ client (native/ray_tpu_client.h);
+        pickled Python objects use put_serialized instead."""
+        buf = self.create(object_id, len(data), 0)
+        buf[:len(data)] = data
+        self.seal(object_id)
+        return len(data)
+
+    def get_raw(self, object_id: ObjectID) -> Optional[bytes]:
+        """Raw-convention read (copies out + releases the pin)."""
+        got = self.get(object_id)
+        if got is None:
+            return None
+        data_v, meta_v = got
+        try:
+            return bytes(data_v)
+        finally:
+            del data_v, meta_v, got
+            self.release(object_id)
+
     def get_frames(self, object_id: ObjectID) -> Optional[List[memoryview]]:
         got = self.get(object_id)
         if got is None:

@@ -432,4 +432,11 @@ uint64_t shm_store_num_objects(void* handle) {
   return s->hdr->num_objects;
 }
 
+// Segment base address in THIS process — offsets from shm_store_get /
+// shm_store_create_object resolve against it (the C++ client's zero-copy
+// views; Python uses its own mmap of the same segment instead).
+void* shm_store_base_ptr(void* handle) {
+  return static_cast<Store*>(handle)->base;
+}
+
 }  // extern "C"

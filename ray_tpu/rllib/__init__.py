@@ -17,6 +17,8 @@ from .dqn import DQN, DQNConfig, DQNLearner
 from .env import CartPole, Env, VectorEnv, make_env, register_env
 from .impala import IMPALA, IMPALAConfig
 from .learner import ImpalaLearner, LearnerGroup, PPOLearner, vtrace
+from .multi_agent import (MultiAgentBatch, MultiAgentEnv, MultiAgentPPO,
+                          MultiAgentRolloutWorker)
 from .policy import JaxPolicy
 from .replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 from .ppo import PPO, PPOConfig
@@ -30,5 +32,6 @@ __all__ = [
     "IMPALAConfig", "Env", "CartPole", "VectorEnv", "make_env",
     "register_env", "JaxPolicy", "RolloutWorker", "SampleBatch",
     "concat_samples", "compute_gae", "PPOLearner", "ImpalaLearner",
-    "LearnerGroup", "vtrace",
+    "LearnerGroup", "vtrace", "MultiAgentEnv", "MultiAgentBatch",
+    "MultiAgentPPO", "MultiAgentRolloutWorker",
 ]

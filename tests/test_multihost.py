@@ -190,3 +190,42 @@ def test_remote_worker_logs_mirrored_to_driver(tcp_cluster, capfd):
         time.sleep(0.2)
     assert "hello-from-remote-node-abc" in seen
     assert f"(node{remote.node_idx}-worker-" in seen
+
+
+def test_remote_driver_attaches_over_tcp(tcp_cluster):
+    """A DRIVER in another process joins over TCP as a full peer (the
+    reference's Ray Client use case — remote notebooks/CI drivers): it
+    gets its own node + object store, so put/get/tasks work unproxied."""
+    import os
+    import subprocess
+    import sys
+
+    cluster, handles = tcp_cluster
+    addr = cluster.enable_tcp()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    script = f"""
+import ray_tpu
+ray_tpu.init(address={addr!r}, num_cpus=0, log_to_driver=False)
+
+@ray_tpu.remote
+def double(x):
+    return 2 * x
+
+print('tasks:', ray_tpu.get([double.remote(i) for i in range(4)],
+                            timeout=60))
+import numpy as np
+ref = ray_tpu.put(np.arange(50_000))
+print('put/get:', int(ray_tpu.get(ref, timeout=60).sum()))
+print('nodes:', len(ray_tpu.nodes()))
+ray_tpu.shutdown()
+print('REMOTE DRIVER OK')
+"""
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "tasks: [0, 2, 4, 6]" in out.stdout
+    assert f"put/get: {sum(range(50_000))}" in out.stdout
+    assert "nodes: 2" in out.stdout  # head node + the driver's node
+    assert "REMOTE DRIVER OK" in out.stdout
