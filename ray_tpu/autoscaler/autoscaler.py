@@ -24,6 +24,10 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 
+# node label marking autoscaler-launched nodes (value = provider node id)
+PROVIDER_LABEL = "ray_tpu_autoscaler_id"
+
+
 class NodeProvider:
     """Minimal provider surface (ref: autoscaler/node_provider.py)."""
 
@@ -59,17 +63,21 @@ class LocalNodeProvider(NodeProvider):
     def create_node(self) -> str:
         import os
 
+        pid = f"local-{self._next}"
+        self._next += 1
         env = dict(os.environ)
         env["PYTHONPATH"] = self._pythonpath + os.pathsep + \
             env.get("PYTHONPATH", "")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.node_agent",
              "--address", self.addr, "--num-cpus", str(self.num_cpus),
-             "--num-tpus", str(self.num_tpus)],
+             "--num-tpus", str(self.num_tpus),
+             # the label lets the autoscaler match registered nodes to
+             # ITS launches (a remote driver or hand-joined agent must
+             # not be adopted and later scaled down)
+             "--label", f"{PROVIDER_LABEL}={pid}"],
             env=env, stdout=subprocess.DEVNULL,
             stderr=subprocess.STDOUT, start_new_session=True)
-        pid = f"local-{self._next}"
-        self._next += 1
         self._procs[pid] = proc
         return pid
 
@@ -172,15 +180,22 @@ class Autoscaler:
         return getattr(self._provider, "num_cpus", 1)
 
     def _reconcile_membership(self):
-        """Match provider nodes to registered head nodes + track idleness."""
+        """Match provider nodes to registered head nodes (by the launch
+        label — adopting ANY new node would let scale-down evict remote
+        drivers or hand-joined agents) + track idleness."""
         with self._head._lock:
             remote = {idx: n for idx, n in self._head.nodes.items()
                       if n.is_remote and n.alive}
-        new_idxs = [i for i in remote if i not in self._known_idxs]
+        by_provider_id = {
+            n.resources.labels.get(PROVIDER_LABEL): idx
+            for idx, n in remote.items()
+            if n.resources.labels.get(PROVIDER_LABEL)}
         for t in self._tracked:
-            if t.node_idx is None and new_idxs:
-                t.node_idx = new_idxs.pop(0)
-                self._known_idxs.add(t.node_idx)
+            if t.node_idx is None:
+                idx = by_provider_id.get(t.provider_id)
+                if idx is not None and idx not in self._known_idxs:
+                    t.node_idx = idx
+                    self._known_idxs.add(idx)
         now = time.monotonic()
         for t in self._tracked:
             node = remote.get(t.node_idx)

@@ -28,7 +28,8 @@ from .ids import ObjectID
 
 
 class _Count:
-    __slots__ = ("local", "task_args", "borrowers", "owned", "freed")
+    __slots__ = ("local", "task_args", "borrowers", "owned", "freed",
+                 "ever_shared")
 
     def __init__(self):
         self.local = 0
@@ -36,6 +37,11 @@ class _Count:
         self.borrowers: Set[str] = set()
         self.owned = False
         self.freed = False
+        # ever lent out (task arg / borrower): only shared objects need
+        # the grace-deferred free — an object that never left this
+        # process cannot have a BORROW_ADD in flight, and deferring its
+        # free keeps arena space pinned (put-churn bandwidth collapses)
+        self.ever_shared = False
 
     def total(self) -> int:
         return self.local + self.task_args + len(self.borrowers)
@@ -118,6 +124,7 @@ class ReferenceCounter:
                 self._owners[ref.id] = ref.owner
 
     def remove_local_ref(self, ref) -> None:
+        free_now = None
         defer_free = None
         borrow_release = None
         with self._lock:
@@ -127,12 +134,19 @@ class ReferenceCounter:
             c.local -= 1
             if c.local <= 0 and c.task_args == 0:
                 if c.owned and not c.borrowers and not c.freed:
-                    defer_free = ref.id
+                    if c.ever_shared:
+                        defer_free = ref.id
+                    else:  # never left this process: free immediately
+                        c.freed = True
+                        free_now = ref.id
+                        self._counts.pop(ref.id, None)
                 elif not c.owned:
                     owner = self._owners.pop(ref.id, None)
                     self._counts.pop(ref.id, None)
                     if owner:
                         borrow_release = (ref.id, owner)
+        if free_now is not None:
+            self._free_cb(free_now)
         if defer_free is not None:
             self._schedule_free(defer_free)
         if borrow_release is not None:
@@ -142,6 +156,7 @@ class ReferenceCounter:
         with self._lock:
             c = self._counts.setdefault(oid, _Count())
             c.task_args += 1
+            c.ever_shared = True
 
     def remove_task_arg(self, oid: ObjectID):
         defer_free = None
@@ -161,6 +176,7 @@ class ReferenceCounter:
             c = self._counts.setdefault(oid, _Count())
             c.owned = True
             c.borrowers.add(borrower)
+            c.ever_shared = True
 
     def remove_borrower(self, oid: ObjectID, borrower: str):
         defer_free = None

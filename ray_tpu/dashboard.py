@@ -89,9 +89,12 @@ class _Handler(BaseHTTPRequestHandler):
 
                 try:
                     mgr = ray_tpu.get_actor(JOB_MANAGER_NAME)
-                    self._json(ray_tpu.get(mgr.list.remote(), timeout=10))
-                except Exception:  # noqa: BLE001 — no jobs submitted yet
+                except ValueError:  # manager never created: no jobs yet
                     self._json([])
+                else:
+                    # a dead/stuck manager surfaces as 500, not as an
+                    # empty-but-healthy list
+                    self._json(ray_tpu.get(mgr.list.remote(), timeout=10))
             elif path == "/api/metrics":
                 self._json(metrics.metrics_summary())
             elif path == "/metrics":

@@ -98,14 +98,32 @@ class _JobManager:
         with self._lock:
             return [dict(v) for v in self._jobs.values()]
 
-    def logs(self, job_id: str) -> str:
+    def logs(self, job_id: str, offset: int = 0) -> str:
+        """Log text from BYTE ``offset`` (tailing clients track bytes so
+        a chatty multi-hour job is not re-read every poll). Reads in
+        binary — a text-mode seek would land mid-character for UTF-8."""
         self.status(job_id)  # raises on unknown id
         path = os.path.join(self._log_dir, f"{job_id}.log")
         try:
-            with open(path, errors="replace") as f:
-                return f.read()
+            with open(path, "rb") as f:
+                if offset:
+                    f.seek(offset)
+                return f.read().decode("utf-8", errors="replace")
         except OSError:
             return ""
+
+    def logs_from(self, job_id: str, offset: int = 0):
+        """-> (text, next_byte_offset) for exact tailing."""
+        self.status(job_id)
+        path = os.path.join(self._log_dir, f"{job_id}.log")
+        try:
+            with open(path, "rb") as f:
+                if offset:
+                    f.seek(offset)
+                blob = f.read()
+        except OSError:
+            return "", offset
+        return blob.decode("utf-8", errors="replace"), offset + len(blob)
 
     def stop(self, job_id: str) -> bool:
         with self._lock:
@@ -161,8 +179,9 @@ class JobSubmissionClient:
     def get_job_info(self, job_id: str) -> dict:
         return ray_tpu.get(self._manager.status.remote(job_id), timeout=60)
 
-    def get_job_logs(self, job_id: str) -> str:
-        return ray_tpu.get(self._manager.logs.remote(job_id), timeout=60)
+    def get_job_logs(self, job_id: str, offset: int = 0) -> str:
+        return ray_tpu.get(self._manager.logs.remote(job_id, offset),
+                           timeout=60)
 
     def list_jobs(self) -> List[dict]:
         return ray_tpu.get(self._manager.list.remote(), timeout=60)
@@ -174,17 +193,21 @@ class JobSubmissionClient:
         return ray_tpu.get(self._manager.delete.remote(job_id), timeout=60)
 
     def tail_job_logs(self, job_id: str, poll_s: float = 0.5):
-        """Generator of new log text until the job finishes."""
+        """Generator of new log text until the job finishes. Each poll
+        ships only the unseen suffix; offsets are BYTES (len(str) would
+        drift behind on multi-byte UTF-8 and re-yield garbled text)."""
         seen = 0
         while True:
-            text = self.get_job_logs(job_id)
-            if len(text) > seen:
-                yield text[seen:]
-                seen = len(text)
+            new, seen = ray_tpu.get(
+                self._manager.logs_from.remote(job_id, seen), timeout=60)
+            if new:
+                yield new
             if self.get_job_status(job_id) not in (PENDING, RUNNING):
-                tail = self.get_job_logs(job_id)
-                if len(tail) > seen:
-                    yield tail[seen:]
+                new, seen = ray_tpu.get(
+                    self._manager.logs_from.remote(job_id, seen),
+                    timeout=60)
+                if new:
+                    yield new
                 return
             time.sleep(poll_s)
 

@@ -31,6 +31,10 @@ class TransformerConfig:
     param_dtype: jnp.dtype = jnp.float32   # master weights
     tie_embeddings: bool = False
     remat: bool = True                     # checkpoint each layer (HBM <-> FLOPs)
+    # "nothing": rematerialize everything (min HBM); "dots": save matmul
+    # outputs, recompute elementwise only (less recompute FLOPs -> higher
+    # MFU when the saved activations still fit HBM)
+    remat_policy: str = "nothing"
     # "auto": ring attention iff mesh's sequence axis > 1, else pallas flash
     # on TPU, else plain XLA attention.
     attention_impl: str = "auto"

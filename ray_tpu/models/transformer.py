@@ -195,8 +195,11 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
 
     body = block
     if cfg.remat:
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.nothing_saveable)
+        policy = (
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            if getattr(cfg, "remat_policy", "nothing") == "dots"
+            else jax.checkpoint_policies.nothing_saveable)
+        body = jax.checkpoint(body, policy=policy)
     aux = jnp.zeros((), jnp.float32)
     if mesh is not None and mesh.shape.get("pipeline", 1) > 1:
         # GPipe-style microbatched stages over the pipeline mesh axis; the

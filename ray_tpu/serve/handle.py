@@ -86,6 +86,14 @@ class DeploymentResponseGenerator:
         if self._done and self._released:
             return
         self._done = True
+        if self._sid is None:
+            # start_stream already ran on the replica even if nobody ever
+            # pulled a chunk — resolve the id (best effort) or the
+            # replica's slot + parked iterator leak forever
+            try:
+                self._sid = ray_tpu.get(self._sid_ref, timeout=10)
+            except Exception:  # noqa: BLE001 — start_stream itself failed
+                pass
         if self._sid is not None:
             try:
                 self._replica.cancel_stream.remote(self._sid)

@@ -51,6 +51,7 @@ class ServeReplica:
         self._completed = 0
         self._healthy = True
         self._draining = False
+        self._streams = {}      # stream id -> (iterator, meta)
         init_args = _resolve_markers(spec["init_args"])
         init_kwargs = _resolve_markers(spec["init_kwargs"])
         if self._is_function:
@@ -111,43 +112,56 @@ class ServeReplica:
         sid = uuid.uuid4().hex[:16]
         with self._lock:
             self._ongoing += 1
-            if not hasattr(self, "_streams"):
-                self._streams = {}
-            self._streams[sid] = it
+            self._streams[sid] = (it, meta or {})
         return sid
 
     def cancel_stream(self, sid: str):
         """Abandoned stream (client gone): drop the parked iterator and
         free its request slot."""
         with self._lock:
-            it = getattr(self, "_streams", {}).pop(sid, None)
-            if it is not None:
+            entry = self._streams.pop(sid, None)
+            if entry is not None:
                 self._ongoing -= 1
                 self._completed += 1
-        if it is not None and hasattr(it, "close"):
+        if entry is not None and hasattr(entry[0], "close"):
             try:
-                it.close()
+                entry[0].close()
             except Exception:  # noqa: BLE001 — generator cleanup
                 pass
 
-    def stream_next(self, sid: str, max_items: int = 8):
-        """-> (items, done). Pulls up to max_items from the stream."""
+    def stream_next(self, sid: str, max_items: int = 1):
+        """-> (items, done). Pulls up to max_items from the stream.
+
+        Default 1: each chunk ships as soon as the generator produces
+        it — a larger batch would delay time-to-first-token by the whole
+        batch and time out slow producers. Callers wanting fewer RPCs on
+        fast streams can raise max_items."""
+        from .multiplex import _set_request_model_id
+
         with self._lock:
-            it = getattr(self, "_streams", {}).get(sid)
-        if it is None:
+            entry = self._streams.get(sid)
+        if entry is None:
             raise KeyError(f"no such stream {sid}")
+        it, meta = entry
         items = []
         done = False
+        # generator frames execute during next() — the request context
+        # must be live HERE, not just in start_stream
+        _set_request_model_id(meta.get("multiplexed_model_id", ""))
         try:
             for _ in range(max_items):
                 items.append(next(it))
         except StopIteration:
             done = True
+        finally:
+            _set_request_model_id("")
         if done:
             with self._lock:
-                self._streams.pop(sid, None)
-                self._ongoing -= 1
-                self._completed += 1
+                # guard against a concurrent cancel_stream having already
+                # released the slot
+                if self._streams.pop(sid, None) is not None:
+                    self._ongoing -= 1
+                    self._completed += 1
         return items, done
 
     # ---------------------------------------------------------- management

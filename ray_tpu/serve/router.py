@@ -119,7 +119,14 @@ class Router:
                     rid, handle = choice
                     self._inflight[rid] = self._inflight.get(rid, 0) + 1
                     if model_id:
-                        self._model_affinity[model_id] = rid
+                        # pin affinity only when the model has no live
+                        # holder: a request spilling off a momentarily
+                        # saturated holder must not migrate the model
+                        # (load/evict ping-pong under bursts)
+                        cur = self._model_affinity.get(model_id)
+                        if cur is None or cur not in {
+                                r for r, _ in self._replicas}:
+                            self._model_affinity[model_id] = rid
                     return rid, handle
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:

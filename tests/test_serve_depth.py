@@ -153,3 +153,23 @@ def test_cli_serve_and_job_parsers():
     assert a.job_cmd == "submit"
     a = p.parse_args(["job", "logs", "some-job"])
     assert a.job_id == "some-job"
+
+
+def test_streaming_with_multiplexed_model(serve_rt):
+    """Generator bodies run lazily in stream_next — the multiplexed
+    model id must be live there, not just in start_stream."""
+    @serve.deployment(num_replicas=1)
+    class MuxStream:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            return model_id.upper()
+
+        def __call__(self, n):
+            model = self.get_model(serve.get_multiplexed_model_id())
+            for i in range(int(n)):
+                yield f"{model}-{i}"
+
+    handle = serve.run(MuxStream.bind(), name="muxstream")
+    out = list(handle.options(stream=True,
+                              multiplexed_model_id="m1").remote(3))
+    assert out == ["M1-0", "M1-1", "M1-2"]
