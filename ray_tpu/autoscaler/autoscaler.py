@@ -29,10 +29,20 @@ PROVIDER_LABEL = "ray_tpu_autoscaler_id"
 
 
 class NodeProvider:
-    """Minimal provider surface (ref: autoscaler/node_provider.py)."""
+    """Minimal provider surface (ref: autoscaler/node_provider.py).
+
+    CONTRACT: ``create_node`` must arrange for the launched node agent to
+    register with the head carrying the label
+    ``PROVIDER_LABEL=<returned id>`` (pass
+    ``--label ray_tpu_autoscaler_id=<id>`` to the agent) — the autoscaler
+    matches registered nodes to its launches by that label; unlabeled
+    nodes are never adopted (so it cannot scale down somebody else's
+    node) and therefore never scale down either. The autoscaler logs a
+    warning when a launch stays unmatched past the grace period."""
 
     def create_node(self) -> str:
-        """Launch one node; returns a provider node id."""
+        """Launch one node; returns a provider node id (see the label
+        contract above)."""
         raise NotImplementedError
 
     def terminate_node(self, provider_id: str):
@@ -109,6 +119,7 @@ class _TrackedNode:
     node_idx: Optional[int] = None      # filled once it registers
     launched_at: float = field(default_factory=time.monotonic)
     idle_since: Optional[float] = None
+    warned: bool = False                # label-contract warning emitted
 
 
 class Autoscaler:
@@ -190,12 +201,23 @@ class Autoscaler:
             n.resources.labels.get(PROVIDER_LABEL): idx
             for idx, n in remote.items()
             if n.resources.labels.get(PROVIDER_LABEL)}
+        now_mono = time.monotonic()
         for t in self._tracked:
             if t.node_idx is None:
                 idx = by_provider_id.get(t.provider_id)
                 if idx is not None and idx not in self._known_idxs:
                     t.node_idx = idx
                     self._known_idxs.add(idx)
+                elif now_mono - t.launched_at > 120 and not t.warned:
+                    t.warned = True
+                    import sys
+
+                    print(
+                        f"ray_tpu autoscaler: launch {t.provider_id} has "
+                        f"not registered with label {PROVIDER_LABEL}="
+                        f"{t.provider_id} after 120s — the provider must "
+                        f"pass it or the node can never be scaled down "
+                        f"(see NodeProvider docstring)", file=sys.stderr)
         now = time.monotonic()
         for t in self._tracked:
             node = remote.get(t.node_idx)

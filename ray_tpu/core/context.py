@@ -315,7 +315,13 @@ class CoreContext:
             # Inner refs stay alive at least as long as the outer object is
             # tracked by this owner (simplified containment pinning; the
             # reference tracks contained ids in the outer's metadata).
+            # They also count as SHARED: a peer that fetches the outer
+            # object deserializes them and its BORROW_ADD may still be in
+            # flight when our containment pin drops — the free must take
+            # the grace window.
             self._contained[oid] = list(sv.contained_refs)
+            for r in sv.contained_refs:
+                self.ref_counter.mark_shared(r.id)
         self.store.put_serialized(oid, sv.frames)
         self.head.send(P.OBJECT_SEALED, oid.binary(), self.node_idx,
                        sv.total_bytes, self.worker_id)

@@ -158,6 +158,15 @@ class ReferenceCounter:
             c.task_args += 1
             c.ever_shared = True
 
+    def mark_shared(self, oid: ObjectID):
+        """The ref escaped this process by some path other than a task
+        arg/borrow registration (e.g. serialized inside a put() object a
+        peer may deserialize) — its free must take the grace window."""
+        with self._lock:
+            c = self._counts.get(oid)
+            if c is not None:
+                c.ever_shared = True
+
     def remove_task_arg(self, oid: ObjectID):
         defer_free = None
         with self._lock:

@@ -100,21 +100,15 @@ class _JobManager:
 
     def logs(self, job_id: str, offset: int = 0) -> str:
         """Log text from BYTE ``offset`` (tailing clients track bytes so
-        a chatty multi-hour job is not re-read every poll). Reads in
-        binary — a text-mode seek would land mid-character for UTF-8."""
-        self.status(job_id)  # raises on unknown id
-        path = os.path.join(self._log_dir, f"{job_id}.log")
-        try:
-            with open(path, "rb") as f:
-                if offset:
-                    f.seek(offset)
-                return f.read().decode("utf-8", errors="replace")
-        except OSError:
-            return ""
+        a chatty multi-hour job is not re-read every poll)."""
+        return self.logs_from(job_id, offset)[0]
 
     def logs_from(self, job_id: str, offset: int = 0):
-        """-> (text, next_byte_offset) for exact tailing."""
-        self.status(job_id)
+        """-> (text, next_byte_offset) for exact tailing. Reads binary (a
+        text-mode seek would land mid-character) and holds back an
+        incomplete trailing UTF-8 sequence so a multi-byte character
+        split across a poll boundary is never emitted as U+FFFD."""
+        self.status(job_id)  # raises on unknown id
         path = os.path.join(self._log_dir, f"{job_id}.log")
         try:
             with open(path, "rb") as f:
@@ -123,7 +117,19 @@ class _JobManager:
                 blob = f.read()
         except OSError:
             return "", offset
-        return blob.decode("utf-8", errors="replace"), offset + len(blob)
+        # trim an incomplete trailing multi-byte sequence (<= 3 bytes)
+        keep = len(blob)
+        for back in range(1, min(4, keep + 1)):
+            b = blob[keep - back]
+            if b < 0x80:          # ASCII: sequence complete
+                break
+            if b >= 0xC0:         # start byte: complete iff its length fits
+                need = 2 + (b >= 0xE0) + (b >= 0xF0)
+                if back < need:
+                    keep -= back  # truncated sequence: hold it back
+                break
+        blob = blob[:keep]
+        return blob.decode("utf-8", errors="replace"), offset + keep
 
     def stop(self, job_id: str) -> bool:
         with self._lock:

@@ -22,6 +22,7 @@ from .api import (
     delete,
     get_app_handle,
     get_deployment_handle,
+    proxy_ports,
     run,
     shutdown,
     start,
@@ -37,7 +38,7 @@ from .schema import deploy_config
 
 __all__ = [
     "deployment", "Deployment", "Application", "run", "delete", "status",
-    "shutdown", "start", "batch", "get_app_handle", "get_deployment_handle",
+    "shutdown", "start", "proxy_ports", "batch", "get_app_handle", "get_deployment_handle",
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
     "multiplexed", "get_multiplexed_model_id", "deploy_config",
     "AutoscalingConfig",

@@ -168,10 +168,44 @@ def delete(name: str, _blocking: bool = True):
     reset_routers()
 
 
+def _proxy_name(node_idx: int) -> str:
+    return PROXY_NAME if node_idx == 0 else f"{PROXY_NAME}_{node_idx}"
+
+
 def start(http_options: Optional[HTTPOptions] = None) -> int:
-    """Start the HTTP proxy (idempotent); returns the bound port."""
+    """Start HTTP ingress (idempotent); returns the head proxy's port.
+
+    With ``HTTPOptions(location="EveryNode")`` a proxy actor is pinned to
+    EVERY alive node (the reference's per-node proxy fleet,
+    serve/_private/http_state.py) — each serves the same route table, so
+    an external load balancer can front all of them. ``proxy_ports()``
+    lists the fleet."""
     get_or_create_controller()
     http_options = http_options or HTTPOptions()
+    if http_options.location == "EveryNode":
+        from ray_tpu.core.api import NodeAffinitySchedulingStrategy
+
+        # create the whole fleet first, then collect ports (a blocking
+        # get per node would serialize startup at N x actor-boot time)
+        proxies = {}
+        for node in ray_tpu.nodes():
+            if not node.get("alive", True):
+                continue
+            idx = node["node_idx"]
+            name = _proxy_name(idx)
+            try:
+                proxies[idx] = ray_tpu.get_actor(name)
+            except ValueError:
+                proxies[idx] = ray_tpu.remote(HTTPProxy).options(
+                    name=name, num_cpus=0, max_concurrency=32,
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        idx)).remote(
+                    http_options.host,
+                    http_options.port + idx if http_options.port else 0)
+        port_refs = {idx: p.port.remote() for idx, p in proxies.items()}
+        ports = {idx: ray_tpu.get(r, timeout=60)
+                 for idx, r in port_refs.items()}
+        return ports[0]
     try:
         proxy = ray_tpu.get_actor(PROXY_NAME)
     except ValueError:
@@ -181,19 +215,36 @@ def start(http_options: Optional[HTTPOptions] = None) -> int:
     return ray_tpu.get(proxy.port.remote(), timeout=30)
 
 
+def proxy_ports() -> dict:
+    """node_idx -> bound HTTP port for every live proxy actor."""
+    out = {}
+    for node in ray_tpu.nodes():
+        idx = node["node_idx"]
+        try:
+            proxy = ray_tpu.get_actor(_proxy_name(idx))
+        except ValueError:
+            continue
+        out[idx] = ray_tpu.get(proxy.port.remote(), timeout=30)
+    return out
+
+
 def shutdown():
     """Tear down all applications, the proxy, and the controller."""
     from .router import reset_routers
 
-    try:
-        proxy = ray_tpu.get_actor(PROXY_NAME)
+    proxy_names = [_proxy_name(n["node_idx"]) for n in ray_tpu.nodes()]
+    if PROXY_NAME not in proxy_names:
+        proxy_names.append(PROXY_NAME)  # head proxy of a shrunken cluster
+    for name in proxy_names:
         try:
-            ray_tpu.get(proxy.stop.remote(), timeout=10)
-        except Exception:
+            proxy = ray_tpu.get_actor(name)
+            try:
+                ray_tpu.get(proxy.stop.remote(), timeout=10)
+            except Exception:
+                pass
+            ray_tpu.kill(proxy)
+        except ValueError:
             pass
-        ray_tpu.kill(proxy)
-    except ValueError:
-        pass
     try:
         ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:

@@ -47,6 +47,10 @@ class DeploymentConfig:
 class HTTPOptions:
     host: str = "127.0.0.1"
     port: int = 8000
+    # "HeadOnly": one proxy on the head node; "EveryNode": one proxy per
+    # alive node, pinned there (the reference's http_state.py proxy fleet
+    # — ingress scales with the cluster, a pod LB fronts all of them)
+    location: str = "HeadOnly"
 
 
 @dataclasses.dataclass

@@ -173,3 +173,28 @@ def test_streaming_with_multiplexed_model(serve_rt):
     out = list(handle.options(stream=True,
                               multiplexed_model_id="m1").remote(3))
     assert out == ["M1-0", "M1-1", "M1-2"]
+
+
+def test_proxy_fleet_every_node(serve_rt):
+    """HTTPOptions(location="EveryNode") pins one proxy per node, all
+    serving the same routes (ref: per-node http_state proxy fleet)."""
+    from ray_tpu.cluster_utils import Cluster  # noqa: F401  (docs pointer)
+    from ray_tpu.core.api import _head
+    from ray_tpu.serve import HTTPOptions
+
+    _head.add_node(num_cpus=1)  # second logical node
+
+    @serve.deployment
+    def hello(x):
+        return {"hi": x}
+
+    serve.run(hello.bind(), name="fleet", route_prefix="/fleet")
+    serve.start(HTTPOptions(port=0, location="EveryNode"))
+    ports = serve.proxy_ports()
+    assert set(ports) == {0, 1}
+    for port in ports.values():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/fleet", data=b'"x"',
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert json.loads(r.read()) == {"hi": "x"}
