@@ -98,6 +98,109 @@ class Cluster:
         api.shutdown()
 
 
+class NodeKiller:
+    """Randomized fault-injection harness.
+
+    Analog of the reference's chaos ``NodeKillerActor``
+    (python/ray/_private/test_utils.py:1386): a background thread that,
+    at random intervals, kills a random *non-head* node — logical nodes
+    via ``Cluster.remove_node`` and real agent processes via
+    ``RemoteNodeHandle.terminate`` — while a workload runs. With
+    ``respawn=True`` (the default) each killed logical node is replaced
+    by a fresh node with the same CPU/TPU totals, so the cluster keeps
+    capacity and a retried/lineage-reconstructed workload should
+    converge despite the carnage.
+
+    Usage::
+
+        killer = NodeKiller(cluster, max_kills=3, seed=7)
+        killer.start()
+        ...run workload with max_retries=-1...
+        killer.stop()
+        assert killer.kills  # at least one node actually died
+    """
+
+    def __init__(self, cluster: Cluster, *,
+                 interval_s=(0.2, 0.8), max_kills: int = 3,
+                 respawn: bool = True, seed: Optional[int] = None,
+                 protect=(0,), remote_handles=()):
+        import random
+
+        self._cluster = cluster
+        self._interval = interval_s
+        self._max_kills = max_kills
+        self._respawn = respawn
+        self._protect = set(protect)
+        self._remote = list(remote_handles)
+        self._rng = random.Random(seed)
+        self._stop = None
+        self._thread = None
+        #: [(monotonic_time, node_idx, kind)] for each node actually killed
+        self.kills = []
+        #: exception that ended the killer thread early, if any
+        self.error = None
+
+    def _eligible(self):
+        head = self._cluster.head
+        logical = [(idx, n) for idx, n in list(head.nodes.items())
+                   if idx not in self._protect and not n.is_remote]
+        remote = [h for h in self._remote
+                  if h.proc.poll() is None and
+                  h.node_idx not in self._protect]
+        return logical, remote
+
+    def _kill_one(self):
+        import time
+
+        logical, remote = self._eligible()
+        choices = [("logical", v) for v in logical] + \
+                  [("remote", h) for h in remote]
+        if not choices:
+            return False
+        kind, victim = self._rng.choice(choices)
+        if kind == "logical":
+            idx, node = victim
+            total = node.resources.total.to_dict()
+            self._cluster.remove_node(idx)
+            self.kills.append((time.monotonic(), idx, "logical"))
+            if self._respawn:
+                self._cluster.add_node(
+                    num_cpus=int(total.get("CPU", 1)) or 1,
+                    num_tpus=int(total.get("TPU", 0)))
+        else:
+            victim.terminate()
+            self.kills.append((time.monotonic(), victim.node_idx, "remote"))
+        return True
+
+    def _run(self):
+        lo, hi = self._interval
+        while not self._stop.is_set() and len(self.kills) < self._max_kills:
+            if self._stop.wait(self._rng.uniform(lo, hi)):
+                break
+            try:
+                self._kill_one()
+            except Exception as e:
+                # a racing cluster shutdown mustn't crash the thread, but
+                # record why injection stopped so tests can surface it
+                self.error = e
+                break
+
+    def start(self):
+        import threading
+
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="node-killer", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0):
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+
 class RemoteNodeHandle:
     def __init__(self, proc, node_idx: int):
         self.proc = proc

@@ -949,8 +949,11 @@ class CoreContext:
             inf = self._inflight.get(spec.task_id)
             if inf is None:
                 return
-            if count_retry and inf.retries_left > 0:
-                inf.retries_left -= 1
+            # negative retries_left means infinite retries (reference
+            # semantics for max_retries=-1, python/ray/remote_function.py)
+            if count_retry and inf.retries_left != 0:
+                if inf.retries_left > 0:
+                    inf.retries_left -= 1
                 st = self._classes.setdefault(spec.scheduling_class(),
                                               _ClassState())
                 st.queue.append(spec)

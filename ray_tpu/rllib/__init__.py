@@ -14,7 +14,8 @@ Algorithms are Tune Trainables, so ``Tuner(PPO, param_space=...)`` works.
 
 from .algorithm import Algorithm, AlgorithmConfig
 from .dqn import DQN, DQNConfig, DQNLearner
-from .env import CartPole, Env, VectorEnv, make_env, register_env
+from .env import (CartPole, Env, Pendulum, VectorEnv, make_env,
+                  register_env)
 from .impala import IMPALA, IMPALAConfig
 from .learner import ImpalaLearner, LearnerGroup, PPOLearner, vtrace
 from .multi_agent import (MultiAgentBatch, MultiAgentEnv, MultiAgentPPO,
@@ -22,7 +23,8 @@ from .multi_agent import (MultiAgentBatch, MultiAgentEnv, MultiAgentPPO,
 from .policy import JaxPolicy
 from .replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 from .ppo import PPO, PPOConfig
-from .rollout_worker import RolloutWorker
+from .rollout_worker import ContinuousRolloutWorker, RolloutWorker
+from .sac import SAC, SACConfig, SACLearner
 from .sample_batch import SampleBatch, compute_gae, concat_samples
 
 __all__ = [
@@ -34,4 +36,6 @@ __all__ = [
     "concat_samples", "compute_gae", "PPOLearner", "ImpalaLearner",
     "LearnerGroup", "vtrace", "MultiAgentEnv", "MultiAgentBatch",
     "MultiAgentPPO", "MultiAgentRolloutWorker",
+    "SAC", "SACConfig", "SACLearner", "Pendulum",
+    "ContinuousRolloutWorker",
 ]

@@ -105,6 +105,7 @@ class Algorithm(Trainable):
     iteration = one call of ``training_step()``."""
 
     _config_cls = AlgorithmConfig
+    _worker_cls = RolloutWorker  # SAC swaps in ContinuousRolloutWorker
 
     @classmethod
     def get_default_config(cls) -> AlgorithmConfig:
@@ -122,7 +123,7 @@ class Algorithm(Trainable):
         cfg.update_from_dict(
             {k: v for k, v in config.items() if k != "__algo_config__"})
         self.algo_config = cfg
-        worker_cls = ray_tpu.remote(RolloutWorker)
+        worker_cls = ray_tpu.remote(self._worker_cls)
         self.workers: List = [
             worker_cls.options(num_cpus=1).remote(
                 cfg.env, cfg.num_envs_per_worker,
@@ -130,10 +131,14 @@ class Algorithm(Trainable):
                 cfg.model_hiddens, seed=cfg.seed + i, worker_idx=i)
             for i in range(cfg.num_rollout_workers)
         ]
-        probe = self._make_probe_env()
+        probe = self._probe_env = self._make_probe_env()
+        # continuous envs report action_dim where discrete ones report
+        # their action count — the factory knows which it asked for
+        act_dim = (probe.action_dim if getattr(probe, "continuous", False)
+                   else probe.num_actions)
         self.learners = LearnerGroup(
             self._make_learner_factory(cfg, probe.observation_dim,
-                                       probe.num_actions),
+                                       act_dim),
             num_learners=cfg.num_learners)
         self._episode_returns: collections.deque = collections.deque(
             maxlen=50)

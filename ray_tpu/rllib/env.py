@@ -15,11 +15,20 @@ import numpy as np
 
 
 class Env:
-    """Single environment: reset() -> obs; step(a) -> (obs, r, done, info)."""
+    """Single environment: reset() -> obs; step(a) -> (obs, r, done, info).
+
+    Discrete envs set ``num_actions``; continuous envs set
+    ``continuous = True``, ``action_dim``, and ``action_low/high`` (and
+    receive a float32 [action_dim] array in step()).
+    """
 
     observation_dim: int
-    num_actions: int
+    num_actions: int = 0
     max_episode_steps: int = 1000
+    continuous: bool = False
+    action_dim: int = 0
+    action_low: float = -1.0
+    action_high: float = 1.0
 
     def reset(self, seed: Optional[int] = None) -> np.ndarray:
         raise NotImplementedError
@@ -73,9 +82,69 @@ class CartPole(Env):
         theta_dot += self.TAU * theta_acc
         self._state = np.array([x, x_dot, theta, theta_dot], np.float32)
         self._steps += 1
-        done = bool(abs(x) > self.X_LIMIT or abs(theta) > self.THETA_LIMIT
-                    or self._steps >= self.max_episode_steps)
-        return self._state.copy(), 1.0, done, {}
+        fell = bool(abs(x) > self.X_LIMIT or abs(theta) > self.THETA_LIMIT)
+        timeout = self._steps >= self.max_episode_steps
+        info = {"truncated": True} if (timeout and not fell) else {}
+        return self._state.copy(), 1.0, fell or timeout, info
+
+
+class Pendulum(Env):
+    """Torque-controlled pendulum swing-up (continuous actions).
+
+    Same dynamics as gym's pendulum.py (public textbook inverted-pendulum
+    physics): obs = [cos th, sin th, th_dot], action = torque in [-2, 2],
+    reward = -(th^2 + 0.1 th_dot^2 + 0.001 u^2). Episodes are fixed
+    200-step (never "done" early). The continuous-control workhorse for
+    SAC (ref analog: rllib's Pendulum-v1 tuned examples).
+    """
+
+    observation_dim = 3
+    continuous = True
+    action_dim = 1
+    action_low = -2.0
+    action_high = 2.0
+    max_episode_steps = 200
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+
+    def __init__(self):
+        self._rng = np.random.default_rng(0)
+        self._th = 0.0
+        self._th_dot = 0.0
+        self._steps = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array([math.cos(self._th), math.sin(self._th),
+                         self._th_dot], np.float32)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._th = float(self._rng.uniform(-math.pi, math.pi))
+        self._th_dot = float(self._rng.uniform(-1.0, 1.0))
+        self._steps = 0
+        return self._obs()
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action, np.float32).reshape(-1)[0],
+                          -self.MAX_TORQUE, self.MAX_TORQUE))
+        th, th_dot = self._th, self._th_dot
+        norm_th = ((th + math.pi) % (2 * math.pi)) - math.pi
+        cost = norm_th ** 2 + 0.1 * th_dot ** 2 + 0.001 * u ** 2
+        th_dot = th_dot + (3.0 * self.G / (2.0 * self.L) * math.sin(th)
+                           + 3.0 / (self.M * self.L ** 2) * u) * self.DT
+        th_dot = float(np.clip(th_dot, -self.MAX_SPEED, self.MAX_SPEED))
+        th = th + th_dot * self.DT
+        self._th, self._th_dot = th, th_dot
+        self._steps += 1
+        # the episode only ever ends by time limit: pure truncation
+        done = self._steps >= self.max_episode_steps
+        return self._obs(), -cost, done, {"truncated": True} if done else {}
 
 
 class StatelessGuess(Env):
@@ -105,6 +174,7 @@ class StatelessGuess(Env):
 
 _REGISTRY: Dict[str, Callable[[], Env]] = {
     "CartPole-v1": CartPole,
+    "Pendulum-v1": Pendulum,
     "StatelessGuess-v0": StatelessGuess,
 }
 
@@ -141,6 +211,11 @@ class VectorEnv:
         self._ep_len = np.zeros(num_envs, np.int64)
         self.episode_returns: List[float] = []
         self.episode_lengths: List[int] = []
+        # per-step truncation view (time-limit "done"s that must still
+        # bootstrap, ref: postprocessing's TimeLimit handling) and the
+        # PRE-reset terminal observation for done envs
+        self.truncateds = np.zeros(num_envs, np.bool_)
+        self.final_obs = self.obs.copy()
 
     @property
     def observation_dim(self) -> int:
@@ -150,16 +225,34 @@ class VectorEnv:
     def num_actions(self) -> int:
         return self.envs[0].num_actions
 
+    @property
+    def continuous(self) -> bool:
+        return self.envs[0].continuous
+
+    @property
+    def action_dim(self) -> int:
+        return self.envs[0].action_dim
+
     def step(self, actions: np.ndarray):
-        """-> (next_obs [N,D], rewards [N], dones [N])."""
+        """-> (next_obs [N,D], rewards [N], dones [N]).
+
+        ``actions`` is int [N] for discrete envs, float32 [N, action_dim]
+        for continuous ones.
+        """
+        cont = self.continuous
         obs_out = np.empty_like(self.obs)
         rews = np.zeros(self.num_envs, np.float32)
         dones = np.zeros(self.num_envs, np.bool_)
+        self.truncateds = np.zeros(self.num_envs, np.bool_)
         for i, env in enumerate(self.envs):
-            o, r, d, _ = env.step(int(actions[i]))
+            o, r, d, info = env.step(
+                np.asarray(actions[i], np.float32) if cont
+                else int(actions[i]))
             self._ep_rew[i] += r
             self._ep_len[i] += 1
+            self.final_obs[i] = o
             if d:
+                self.truncateds[i] = bool(info.get("truncated", False))
                 self.episode_returns.append(float(self._ep_rew[i]))
                 self.episode_lengths.append(int(self._ep_len[i]))
                 self._ep_rew[i] = 0.0

@@ -54,6 +54,84 @@ def forward(params: Params, obs: jnp.ndarray
     return logits, value
 
 
+# ------------------------------------------------- continuous (SAC) nets
+
+
+def init_gaussian_actor(rng, obs_dim: int, action_dim: int,
+                        hiddens: Sequence[int] = (64, 64)) -> Params:
+    """Tanh-squashed diagonal-Gaussian policy trunk + (mean, log_std)
+    heads (ref analog: rllib SACTorchModel's policy net,
+    rllib/algorithms/sac/sac_torch_model.py — re-done as a pure fn)."""
+    params: Params = {}
+    keys = jax.random.split(rng, len(hiddens) + 2)
+    sizes = [obs_dim, *hiddens]
+    for i in range(len(hiddens)):
+        params[f"w{i}"] = _ortho(keys[i], (sizes[i], sizes[i + 1]),
+                                 gain=jnp.sqrt(2.0))
+        params[f"b{i}"] = jnp.zeros((sizes[i + 1],))
+    params["w_mu"] = _ortho(keys[-2], (sizes[-1], action_dim), gain=0.01)
+    params["b_mu"] = jnp.zeros((action_dim,))
+    params["w_ls"] = _ortho(keys[-1], (sizes[-1], action_dim), gain=0.01)
+    params["b_ls"] = jnp.zeros((action_dim,))
+    return params
+
+
+def gaussian_forward(params: Params, obs: jnp.ndarray
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """-> (mean [B, A], log_std [B, A]), log_std clamped to a sane range."""
+    x = obs
+    n = sum(1 for k in params if k.startswith("w") and k[1:].isdigit())
+    for i in range(n):
+        x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+    mu = x @ params["w_mu"] + params["b_mu"]
+    log_std = jnp.clip(x @ params["w_ls"] + params["b_ls"], -20.0, 2.0)
+    return mu, log_std
+
+
+def squashed_sample(params: Params, obs: jnp.ndarray, rng,
+                    scale: float, shift: float = 0.0
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Reparameterized a = shift + scale*tanh(u), u ~ N(mu, std);
+    -> (a, logp) with the tanh change-of-variables correction. For an
+    action box [low, high], scale = (high-low)/2 and shift =
+    (high+low)/2 (the shift doesn't enter the log-det)."""
+    mu, log_std = gaussian_forward(params, obs)
+    std = jnp.exp(log_std)
+    u = mu + std * jax.random.normal(rng, mu.shape)
+    logp_u = jnp.sum(
+        -0.5 * ((u - mu) / std) ** 2 - log_std
+        - 0.5 * jnp.log(2.0 * jnp.pi), axis=-1)
+    a = jnp.tanh(u)
+    # d/du [scale*tanh(u)] = scale*(1-tanh^2): subtract its log per dim
+    logp = logp_u - jnp.sum(
+        jnp.log(scale * (1.0 - a ** 2) + 1e-6), axis=-1)
+    return shift + scale * a, logp
+
+
+def init_q_net(rng, obs_dim: int, action_dim: int,
+               hiddens: Sequence[int] = (64, 64)) -> Params:
+    """Q(s, a) -> scalar: MLP over the concatenated [obs, action]."""
+    params: Params = {}
+    keys = jax.random.split(rng, len(hiddens) + 1)
+    sizes = [obs_dim + action_dim, *hiddens]
+    for i in range(len(hiddens)):
+        params[f"w{i}"] = _ortho(keys[i], (sizes[i], sizes[i + 1]),
+                                 gain=jnp.sqrt(2.0))
+        params[f"b{i}"] = jnp.zeros((sizes[i + 1],))
+    params["w_q"] = _ortho(keys[-1], (sizes[-1], 1), gain=1.0)
+    params["b_q"] = jnp.zeros((1,))
+    return params
+
+
+def q_forward(params: Params, obs: jnp.ndarray, act: jnp.ndarray
+              ) -> jnp.ndarray:
+    x = jnp.concatenate([obs, act], axis=-1)
+    n = sum(1 for k in params if k.startswith("w") and k[1:].isdigit())
+    for i in range(n):
+        x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+    return (x @ params["w_q"] + params["b_q"]).squeeze(-1)
+
+
 def logp_of(logits: jnp.ndarray, actions: jnp.ndarray) -> jnp.ndarray:
     logps = jax.nn.log_softmax(logits)
     return jnp.take_along_axis(logps, actions[:, None], axis=1).squeeze(-1)

@@ -14,7 +14,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .models import forward, init_actor_critic, logp_of
+from .models import (forward, gaussian_forward, init_actor_critic,
+                     init_gaussian_actor, logp_of, squashed_sample)
 
 
 class JaxPolicy:
@@ -59,6 +60,57 @@ class JaxPolicy:
     def value(self, obs: np.ndarray) -> np.ndarray:
         _, v = self._greedy(self.params, jnp.asarray(obs, jnp.float32))
         return np.asarray(v)
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in self.params.items()}
+
+    def set_weights(self, weights: Dict[str, np.ndarray]):
+        self.params = {k: jnp.asarray(v) for k, v in weights.items()}
+
+
+class SquashedGaussianPolicy:
+    """Continuous-action policy: a = scale*tanh(u), u ~ N(mu, std).
+
+    The rollout-side half of SAC (ref analog: the deterministic/stochastic
+    action path of rllib/algorithms/sac/sac_torch_policy.py) — one jitted
+    sample step; weights move as numpy pytrees like JaxPolicy's.
+    """
+
+    def __init__(self, obs_dim: int, action_dim: int, action_scale: float,
+                 hiddens=(64, 64), seed: int = 0,
+                 action_shift: float = 0.0):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.action_scale = float(action_scale)
+        self.action_shift = float(action_shift)
+        self._rng = jax.random.key(seed)
+        self.params = init_gaussian_actor(
+            jax.random.key(seed), obs_dim, action_dim, hiddens)
+        scale, shift = self.action_scale, self.action_shift
+
+        @jax.jit
+        def _sample(params, obs, rng):
+            return squashed_sample(params, obs, rng, scale, shift)
+
+        @jax.jit
+        def _mean(params, obs):
+            mu, _ = gaussian_forward(params, obs)
+            return shift + scale * jnp.tanh(mu)
+
+        self._sample_fn = _sample
+        self._mean_fn = _mean
+
+    def compute_actions(self, obs: np.ndarray, explore: bool = True
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+        """-> (actions [B, A], logp [B]) as numpy."""
+        obs = jnp.asarray(obs, jnp.float32)
+        if explore:
+            self._rng, sub = jax.random.split(self._rng)
+            a, lp = self._sample_fn(self.params, obs, sub)
+        else:
+            a = self._mean_fn(self.params, obs)
+            lp = jnp.zeros(obs.shape[0])
+        return np.asarray(a), np.asarray(lp)
 
     def get_weights(self) -> Dict[str, np.ndarray]:
         return {k: np.asarray(v) for k, v in self.params.items()}

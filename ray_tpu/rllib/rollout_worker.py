@@ -18,6 +18,44 @@ from .policy import JaxPolicy
 from .sample_batch import SampleBatch, compute_gae
 
 
+def _collect_transitions(vec: VectorEnv, rollout_len: int, select_actions,
+                         act_shape: tuple, act_dtype) -> SampleBatch:
+    """Shared (s, a, r, s', terminated) collection loop for the
+    off-policy paths (DQN's epsilon-greedy and SAC's squashed-Gaussian
+    workers differ only in action selection).
+
+    Stores the PRE-reset terminal observation as NEXT_OBS and masks
+    DONES to TERMINATED only — a time-limit truncation must still
+    bootstrap, or the Bellman target regresses boundary transitions
+    toward r alone (the classic timeout-bootstrap bug).
+    """
+    T, N, D = rollout_len, vec.num_envs, vec.observation_dim
+    obs_buf = np.zeros((T, N, D), np.float32)
+    next_buf = np.zeros((T, N, D), np.float32)
+    act_buf = np.zeros((T, N) + act_shape, act_dtype)
+    rew_buf = np.zeros((T, N), np.float32)
+    done_buf = np.zeros((T, N), np.bool_)
+
+    obs = vec.obs
+    for t in range(T):
+        actions = select_actions(obs)
+        obs_buf[t] = obs
+        act_buf[t] = actions
+        obs, rewards, dones = vec.step(actions)
+        next_buf[t] = vec.final_obs
+        rew_buf[t] = rewards
+        done_buf[t] = dones & ~vec.truncateds
+
+    flat = lambda x: x.reshape((T * N,) + x.shape[2:])  # noqa: E731
+    return SampleBatch({
+        SB.OBS: flat(obs_buf),
+        SB.ACTIONS: flat(act_buf),
+        SB.REWARDS: flat(rew_buf),
+        SB.DONES: flat(done_buf),
+        SB.NEXT_OBS: flat(next_buf),
+    })
+
+
 class RolloutWorker:
     def __init__(self, env_creator, num_envs: int, rollout_len: int,
                  gamma: float, lam: float, hiddens=(64, 64),
@@ -104,42 +142,103 @@ class RolloutWorker:
         the off-policy (DQN) collection path (ref: rollout_worker sample
         with EpsilonGreedy exploration, utils/exploration/epsilon_greedy
         .py). The policy's logits head is read as Q-values."""
-        T, N = self.rollout_len, self.vec.num_envs
-        D = self.vec.observation_dim
-        obs_buf = np.zeros((T, N, D), np.float32)
-        next_buf = np.zeros((T, N, D), np.float32)
-        act_buf = np.zeros((T, N), np.int64)
-        rew_buf = np.zeros((T, N), np.float32)
-        done_buf = np.zeros((T, N), np.bool_)
+        N = self.vec.num_envs
         rng = np.random.default_rng(
             int(epsilon * 1e6) + self.worker_idx * 7919 + self._eps_seq)
         self._eps_seq += 1
 
-        obs = self.vec.obs
-        for t in range(T):
+        def select(obs):
             greedy, _ = self.policy._greedy(
                 self.policy.params, np.asarray(obs, np.float32))
             actions = np.array(greedy)  # writable copy (jax views are RO)
             explore = rng.random(N) < epsilon
             actions[explore] = rng.integers(
                 0, self.vec.num_actions, size=int(explore.sum()))
-            obs_buf[t] = obs
-            act_buf[t] = actions
-            obs, rewards, dones = self.vec.step(actions)
-            next_buf[t] = obs
-            rew_buf[t] = rewards
-            done_buf[t] = dones
+            return actions
 
-        flat = lambda x: x.reshape((T * N,) + x.shape[2:])  # noqa: E731
-        return SampleBatch({
-            SB.OBS: flat(obs_buf),
-            SB.ACTIONS: flat(act_buf),
-            SB.REWARDS: flat(rew_buf),
-            SB.DONES: flat(done_buf),
-            SB.NEXT_OBS: flat(next_buf),
-        })
+        return _collect_transitions(self.vec, self.rollout_len, select,
+                                    (), np.int64)
 
     # ---- weight sync / metrics ----
+
+    def set_weights(self, weights: Dict[str, np.ndarray]):
+        self.policy.set_weights(weights)
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        return self.policy.get_weights()
+
+    def episode_metrics(self) -> dict:
+        rets, lens = self.vec.pop_episode_metrics()
+        return {"episode_returns": rets, "episode_lengths": lens}
+
+    def ping(self) -> bool:
+        return True
+
+
+class ContinuousRolloutWorker:
+    """Rollout actor for continuous-action envs (the SAC collection path).
+
+    Same contract as RolloutWorker.sample_transitions, but actions come
+    from a SquashedGaussianPolicy; ``epsilon`` is the probability of a
+    uniform-random action (warmup exploration before learning starts,
+    ref analog: SACConfig num_steps_sampled_before_learning_starts +
+    random exploration).
+    """
+
+    def __init__(self, env_creator, num_envs: int, rollout_len: int,
+                 gamma: float, lam: float, hiddens=(64, 64),
+                 seed: int = 0, worker_idx: int = 0):
+        from .policy import SquashedGaussianPolicy
+
+        self.vec = VectorEnv(env_creator, num_envs, seed=seed * 1000 + 17)
+        assert self.vec.continuous, "use RolloutWorker for discrete envs"
+        env0 = self.vec.envs[0]
+        self.policy = SquashedGaussianPolicy(
+            self.vec.observation_dim, self.vec.action_dim,
+            action_scale=(env0.action_high - env0.action_low) / 2.0,
+            action_shift=(env0.action_high + env0.action_low) / 2.0,
+            hiddens=hiddens, seed=seed)
+        self.rollout_len = rollout_len
+        self.worker_idx = worker_idx
+        self._rng = np.random.default_rng(seed * 7919 + 23)
+
+    def sample_transitions(self, epsilon: float = 0.0) -> SampleBatch:
+        N, A = self.vec.num_envs, self.vec.action_dim
+        env0 = self.vec.envs[0]
+        lo, hi = env0.action_low, env0.action_high
+
+        def select(obs):
+            if epsilon >= 1.0:  # pure warmup: skip the policy forward
+                return self._rng.uniform(
+                    lo, hi, size=(N, A)).astype(np.float32)
+            actions, _ = self.policy.compute_actions(obs)
+            if epsilon > 0.0:
+                rand = self._rng.random(N) < epsilon
+                if rand.any():
+                    actions = np.array(actions)
+                    actions[rand] = self._rng.uniform(
+                        lo, hi,
+                        size=(int(rand.sum()), A)).astype(np.float32)
+            return actions
+
+        return _collect_transitions(self.vec, self.rollout_len, select,
+                                    (A,), np.float32)
+
+    def evaluate(self, num_episodes: int = 5, seed: int = 0) -> dict:
+        """Deterministic (mean-action) eval on fresh env copies."""
+        from .env import make_env
+
+        env = make_env(self.vec.envs[0].__class__)
+        returns = []
+        for ep in range(num_episodes):
+            obs = env.reset(seed=10_000 + seed * 100 + ep)
+            total, done = 0.0, False
+            while not done:
+                a, _ = self.policy.compute_actions(obs[None], explore=False)
+                obs, r, done, _ = env.step(a[0])
+                total += r
+            returns.append(total)
+        return {"mean_return": float(np.mean(returns)), "returns": returns}
 
     def set_weights(self, weights: Dict[str, np.ndarray]):
         self.policy.set_weights(weights)

@@ -1,0 +1,257 @@
+"""SAC: off-policy maximum-entropy actor-critic for continuous control.
+
+Ref analogs: rllib/algorithms/sac/sac.py:34 (SACConfig: twin-Q, tau,
+target-entropy/alpha knobs, training_step via the DQN-style
+sample->store->replay->learn loop) and sac_torch_policy.py (actor/critic/
+alpha losses). TPU-first re-design: the whole update — twin-critic
+Bellman regression against the entropy-regularized target, reparameterized
+actor step, temperature (alpha) step, and the Polyak target blend — is ONE
+jitted XLA program over a contiguous replay batch; rollouts stay CPU
+actors (ContinuousRolloutWorker).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+
+from . import sample_batch as SB
+from .algorithm import Algorithm, AlgorithmConfig
+from .models import (init_gaussian_actor, init_q_net, q_forward,
+                     squashed_sample)
+from .replay_buffers import ReplayBuffer
+from .rollout_worker import ContinuousRolloutWorker
+from .sample_batch import SampleBatch, concat_samples
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or SAC)
+        self.env = "Pendulum-v1"
+        self.lr = 3e-4
+        self.critic_lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.train_batch_size = 128
+        self.replay_buffer_capacity = 100_000
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.tau = 0.005                 # Polyak target blend
+        self.initial_alpha = 0.2
+        self.target_entropy = None       # None -> -action_dim (SAC paper)
+        self.num_updates_per_iter = 64
+        self.warmup_random_action_prob = 1.0
+
+
+class SACLearner:
+    """Actor + twin critics + targets + learnable temperature; one jitted
+    update step (losses per Haarnoja et al. 2018, the same ones the
+    reference's sac_torch_policy.py implements with three torch
+    optimizers — here a single fused XLA program)."""
+
+    def __init__(self, obs_dim: int, action_dim: int, *, actor_lr: float,
+                 critic_lr: float, alpha_lr: float, gamma: float,
+                 tau: float, action_scale: float, initial_alpha: float,
+                 target_entropy: float, hiddens=(64, 64), seed: int = 0,
+                 action_shift: float = 0.0):
+        k = jax.random.split(jax.random.key(seed), 3)
+        self.state = {
+            "actor": init_gaussian_actor(k[0], obs_dim, action_dim,
+                                         hiddens),
+            "q1": init_q_net(k[1], obs_dim, action_dim, hiddens),
+            "q2": init_q_net(k[2], obs_dim, action_dim, hiddens),
+            "log_alpha": jnp.asarray(float(np.log(initial_alpha))),
+        }
+        self.state["tq1"] = jax.tree.map(jnp.copy, self.state["q1"])
+        self.state["tq2"] = jax.tree.map(jnp.copy, self.state["q2"])
+        self._actor_opt = optax.adam(actor_lr)
+        self._critic_opt = optax.adam(critic_lr)
+        self._alpha_opt = optax.adam(alpha_lr)
+        self.opt_state = {
+            "actor": self._actor_opt.init(self.state["actor"]),
+            "critic": self._critic_opt.init(
+                (self.state["q1"], self.state["q2"])),
+            "alpha": self._alpha_opt.init(self.state["log_alpha"]),
+        }
+        self._rng = jax.random.key(seed + 1)
+        scale, shift = float(action_scale), float(action_shift)
+
+        def critic_loss(qs, actor, tq1, tq2, alpha, batch, rng):
+            q1p, q2p = qs
+            a_next, logp_next = squashed_sample(
+                actor, batch[SB.NEXT_OBS], rng, scale, shift)
+            tq = jnp.minimum(q_forward(tq1, batch[SB.NEXT_OBS], a_next),
+                             q_forward(tq2, batch[SB.NEXT_OBS], a_next))
+            not_done = 1.0 - batch[SB.DONES].astype(jnp.float32)
+            target = batch[SB.REWARDS] + gamma * not_done * (
+                tq - alpha * logp_next)
+            target = jax.lax.stop_gradient(target)
+            e1 = q_forward(q1p, batch[SB.OBS], batch[SB.ACTIONS]) - target
+            e2 = q_forward(q2p, batch[SB.OBS], batch[SB.ACTIONS]) - target
+            return jnp.mean(e1 ** 2) + jnp.mean(e2 ** 2)
+
+        def actor_loss(actor, q1p, q2p, alpha, batch, rng):
+            a, logp = squashed_sample(actor, batch[SB.OBS], rng, scale,
+                                      shift)
+            q = jnp.minimum(q_forward(q1p, batch[SB.OBS], a),
+                            q_forward(q2p, batch[SB.OBS], a))
+            return jnp.mean(alpha * logp - q), logp
+
+        @jax.jit
+        def train_step(state, opt_state, batch, rng):
+            r1, r2 = jax.random.split(rng)
+            alpha = jnp.exp(state["log_alpha"])
+
+            closs, cgrads = jax.value_and_grad(critic_loss)(
+                (state["q1"], state["q2"]), state["actor"],
+                state["tq1"], state["tq2"], alpha, batch, r1)
+            cupd, copt = self._critic_opt.update(
+                cgrads, opt_state["critic"],
+                (state["q1"], state["q2"]))
+            q1, q2 = optax.apply_updates(
+                (state["q1"], state["q2"]), cupd)
+
+            (aloss, logp), agrads = jax.value_and_grad(
+                actor_loss, has_aux=True)(
+                state["actor"], q1, q2, alpha, batch, r2)
+            aupd, aopt = self._actor_opt.update(
+                agrads, opt_state["actor"], state["actor"])
+            actor = optax.apply_updates(state["actor"], aupd)
+
+            # temperature: alpha tracks target entropy on the FRESH logp
+            lgrad = jax.grad(
+                lambda la: -la * jax.lax.stop_gradient(
+                    jnp.mean(logp) + target_entropy))(state["log_alpha"])
+            lupd, lopt = self._alpha_opt.update(
+                lgrad, opt_state["alpha"], state["log_alpha"])
+            log_alpha = optax.apply_updates(state["log_alpha"], lupd)
+
+            blend = lambda t, o: jax.tree.map(  # noqa: E731
+                lambda a, b: tau * a + (1.0 - tau) * b, t, o)
+            new_state = {"actor": actor, "q1": q1, "q2": q2,
+                         "log_alpha": log_alpha,
+                         "tq1": blend(q1, state["tq1"]),
+                         "tq2": blend(q2, state["tq2"])}
+            new_opt = {"actor": aopt, "critic": copt, "alpha": lopt}
+            metrics = {"critic_loss": closs, "actor_loss": aloss,
+                       "alpha": alpha, "entropy": -jnp.mean(logp)}
+            return new_state, new_opt, metrics
+
+        self._train_step = train_step
+
+    def update(self, batch: SampleBatch) -> dict:
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k in (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.DONES,
+                       SB.NEXT_OBS)}
+        self._rng, sub = jax.random.split(self._rng)
+        self.state, self.opt_state, metrics = self._train_step(
+            self.state, self.opt_state, jb, sub)
+        return {k: float(v) for k, v in metrics.items()}
+
+    # weights contract: workers only need the actor
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in self.state["actor"].items()}
+
+    def set_weights(self, weights: Dict[str, np.ndarray]):
+        self.state["actor"] = {k: jnp.asarray(v)
+                               for k, v in weights.items()}
+
+    def full_state(self) -> dict:
+        """Everything resume needs: params/targets/alpha AND the three
+        Adam states + RNG key (restoring without optimizer moments would
+        transiently destabilize the alpha update)."""
+        return {
+            "state": jax.tree.map(np.asarray, self.state),
+            "opt_state": jax.tree.map(np.asarray, self.opt_state),
+            "rng": np.asarray(jax.random.key_data(self._rng)),
+        }
+
+    def load_full_state(self, payload: dict):
+        if "state" not in payload:  # pre-opt_state checkpoint layout
+            self.state = jax.tree.map(jnp.asarray, payload)
+            return
+        self.state = jax.tree.map(jnp.asarray, payload["state"])
+        self.opt_state = jax.tree.map(jnp.asarray, payload["opt_state"])
+        self._rng = jax.random.wrap_key_data(
+            jnp.asarray(payload["rng"]))
+
+
+class SAC(Algorithm):
+    _config_cls = SACConfig
+    _worker_cls = ContinuousRolloutWorker
+
+    def _make_learner_factory(self, cfg, obs_dim, action_dim):
+        probe = self._probe_env  # the probe Algorithm.setup already built
+        scale = (probe.action_high - probe.action_low) / 2.0
+        shift = (probe.action_high + probe.action_low) / 2.0
+        tgt_ent = (cfg.target_entropy if cfg.target_entropy is not None
+                   else -float(action_dim))
+
+        def make():
+            return SACLearner(
+                obs_dim, action_dim, actor_lr=cfg.lr,
+                critic_lr=cfg.critic_lr, alpha_lr=cfg.alpha_lr,
+                gamma=cfg.gamma, tau=cfg.tau, action_scale=scale,
+                action_shift=shift, initial_alpha=cfg.initial_alpha,
+                target_entropy=tgt_ent, hiddens=cfg.model_hiddens,
+                seed=cfg.seed)
+
+        return make
+
+    def setup(self, config):
+        cfg0 = config.get("__algo_config__")
+        # num_learners can arrive on the config object OR as a plain key
+        # (the Tune search-space path algorithm.py merges in setup)
+        if (cfg0 is not None and getattr(cfg0, "num_learners", 0)) or \
+                config.get("num_learners"):
+            raise ValueError(
+                "SAC uses a single local learner (its update is one fused "
+                "XLA program); num_learners > 0 is not supported")
+        super().setup(config)
+        cfg = self.algo_config
+        self.replay = ReplayBuffer(cfg.replay_buffer_capacity,
+                                   seed=cfg.seed)
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        warming_up = (self.replay.num_added <
+                      cfg.num_steps_sampled_before_learning_starts)
+        eps = cfg.warmup_random_action_prob if warming_up else 0.0
+        batches = ray_tpu.get(
+            [w.sample_transitions.remote(eps) for w in self.workers],
+            timeout=300)
+        fresh = concat_samples(batches)
+        self.replay.add(fresh)
+        self._num_env_steps += fresh.count
+
+        metrics = {"env_steps_this_iter": fresh.count,
+                   "replay_size": len(self.replay)}
+        learner = self.learners.local  # SAC updates are local/single-chip
+        if self.replay.num_added >= \
+                cfg.num_steps_sampled_before_learning_starts:
+            last = {}
+            for _ in range(cfg.num_updates_per_iter):
+                sample = self.replay.sample(cfg.train_batch_size)
+                if sample is None:
+                    break
+                last = learner.update(sample)
+            metrics.update(last)
+            self._sync_weights()
+        return metrics
+
+    def save_checkpoint(self):
+        return {"sac_state": self.learners.local.full_state(),
+                "num_env_steps": self._num_env_steps}
+
+    def load_checkpoint(self, checkpoint):
+        if checkpoint and "sac_state" in checkpoint:
+            self.learners.local.load_full_state(checkpoint["sac_state"])
+            self._num_env_steps = checkpoint.get("num_env_steps", 0)
+            self._sync_weights()
+        else:
+            super().load_checkpoint(checkpoint)

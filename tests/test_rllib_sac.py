@@ -1,0 +1,186 @@
+"""SAC (continuous control): Pendulum env physics, squashed-Gaussian
+math, learner mechanics, and an end-to-end learning test.
+
+Analog of the reference's SAC suite (rllib/algorithms/sac/tests/
+test_sac.py — compilation + learning on Pendulum per
+tuned_examples/sac/pendulum-sac.yaml).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    info = ray_tpu.init(num_cpus=4, num_tpus=0, ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+class TestPendulum:
+    def test_physics_and_bounds(self):
+        from ray_tpu.rllib import Pendulum
+
+        env = Pendulum()
+        obs = env.reset(seed=0)
+        assert obs.shape == (3,)
+        # cos^2 + sin^2 = 1 invariant
+        assert abs(obs[0] ** 2 + obs[1] ** 2 - 1.0) < 1e-5
+        total, steps, done = 0.0, 0, False
+        while not done:
+            obs, r, done, _ = env.step(np.array([0.0]))
+            assert r <= 0.0  # reward is a negative cost
+            assert abs(obs[2]) <= env.MAX_SPEED + 1e-6
+            total += r
+            steps += 1
+        assert steps == env.max_episode_steps
+        assert -2000.0 < total < 0.0
+
+    def test_vector_env_continuous(self):
+        from ray_tpu.rllib import VectorEnv
+
+        vec = VectorEnv("Pendulum-v1", 3, seed=1)
+        assert vec.continuous and vec.action_dim == 1
+        acts = np.zeros((3, 1), np.float32)
+        obs, rews, dones = vec.step(acts)
+        assert obs.shape == (3, 3) and rews.shape == (3,)
+
+
+class TestSquashedGaussian:
+    def test_logp_matches_numeric_density(self):
+        """tanh-squash correction: empirical density of a = s*tanh(u)
+        vs exp(logp) at the sample point (1-D, so a histogram works)."""
+        import jax
+
+        from ray_tpu.rllib.models import init_gaussian_actor, \
+            squashed_sample
+
+        params = init_gaussian_actor(jax.random.key(0), 3, 1)
+        obs = np.zeros((50_000, 3), np.float32)
+        a, logp = squashed_sample(params, obs, jax.random.key(1), 2.0)
+        a = np.asarray(a).ravel()
+        logp = np.asarray(logp)
+        assert np.all(np.abs(a) <= 2.0)
+        # histogram density around the median sample ≈ exp(logp) there
+        lo, hi = np.quantile(a, [0.45, 0.55])
+        frac = float(np.mean((a >= lo) & (a < hi)))
+        emp_density = frac / (hi - lo)
+        mid_logp = float(np.median(logp[(a >= lo) & (a < hi)]))
+        assert abs(np.log(emp_density) - mid_logp) < 0.15
+
+    def test_actions_respect_scale(self):
+        from ray_tpu.rllib.policy import SquashedGaussianPolicy
+
+        pol = SquashedGaussianPolicy(3, 1, action_scale=2.0, seed=0)
+        a, logp = pol.compute_actions(np.zeros((64, 3), np.float32))
+        assert a.shape == (64, 1) and np.all(np.abs(a) <= 2.0)
+        det = pol.compute_actions(np.zeros((4, 3), np.float32),
+                                  explore=False)[0]
+        assert np.allclose(det, det[0])  # deterministic mean action
+
+
+class TestSACLearner:
+    def test_update_moves_toward_bellman_target(self):
+        from ray_tpu.rllib import sample_batch as SB
+        from ray_tpu.rllib.sac import SACLearner
+        from ray_tpu.rllib.sample_batch import SampleBatch
+
+        l = SACLearner(3, 1, actor_lr=1e-3, critic_lr=1e-2, alpha_lr=1e-3,
+                       gamma=0.9, tau=0.01, action_scale=2.0,
+                       initial_alpha=0.2, target_entropy=-1.0, seed=0)
+        rng = np.random.default_rng(0)
+        batch = SampleBatch({
+            SB.OBS: rng.normal(size=(256, 3)).astype(np.float32),
+            SB.ACTIONS: rng.uniform(-2, 2, (256, 1)).astype(np.float32),
+            SB.REWARDS: np.full(256, 1.0, np.float32),
+            SB.DONES: np.ones(256, np.bool_),  # => target is exactly r
+            SB.NEXT_OBS: rng.normal(size=(256, 3)).astype(np.float32),
+        })
+        losses = [l.update(batch)["critic_loss"] for _ in range(200)]
+        # all-done transitions make the fixed target r=1: critic regression
+        # must converge toward it
+        assert losses[-1] < losses[0] * 0.2
+
+    def test_target_nets_polyak_blend(self):
+        import jax
+
+        from ray_tpu.rllib import sample_batch as SB
+        from ray_tpu.rllib.sac import SACLearner
+        from ray_tpu.rllib.sample_batch import SampleBatch
+
+        l = SACLearner(3, 1, actor_lr=3e-4, critic_lr=3e-4, alpha_lr=3e-4,
+                       gamma=0.99, tau=0.5, action_scale=2.0,
+                       initial_alpha=0.2, target_entropy=-1.0, seed=0)
+        q_before = jax.tree.map(np.asarray, l.state["tq1"])
+        batch = SampleBatch({
+            SB.OBS: np.zeros((32, 3), np.float32),
+            SB.ACTIONS: np.zeros((32, 1), np.float32),
+            SB.REWARDS: np.ones(32, np.float32),
+            SB.DONES: np.zeros(32, np.bool_),
+            SB.NEXT_OBS: np.zeros((32, 3), np.float32),
+        })
+        l.update(batch)
+        moved = any(
+            not np.allclose(q_before[k], np.asarray(l.state["tq1"][k]))
+            for k in q_before)
+        assert moved  # tau=0.5 blend visibly moves the target
+
+    def test_checkpoint_roundtrip_full_state(self):
+        from ray_tpu.rllib.sac import SACLearner
+
+        l = SACLearner(3, 1, actor_lr=3e-4, critic_lr=3e-4, alpha_lr=3e-4,
+                       gamma=0.99, tau=0.005, action_scale=2.0,
+                       initial_alpha=0.2, target_entropy=-1.0, seed=0)
+        st = l.full_state()
+        assert "opt_state" in st and "rng" in st  # resume-complete payload
+        l2 = SACLearner(3, 1, actor_lr=3e-4, critic_lr=3e-4,
+                        alpha_lr=3e-4, gamma=0.99, tau=0.005,
+                        action_scale=2.0, initial_alpha=0.2,
+                        target_entropy=-1.0, seed=99)
+        l2.load_full_state(st)
+        for k in st["state"]["actor"]:
+            np.testing.assert_array_equal(
+                st["state"]["actor"][k],
+                np.asarray(l2.state["actor"][k]))
+        # restored learners continue identically (opt moments + rng match)
+        rng = np.random.default_rng(1)
+        from ray_tpu.rllib import sample_batch as SB
+        from ray_tpu.rllib.sample_batch import SampleBatch
+
+        batch = SampleBatch({
+            SB.OBS: rng.normal(size=(32, 3)).astype(np.float32),
+            SB.ACTIONS: rng.uniform(-2, 2, (32, 1)).astype(np.float32),
+            SB.REWARDS: np.ones(32, np.float32),
+            SB.DONES: np.zeros(32, np.bool_),
+            SB.NEXT_OBS: rng.normal(size=(32, 3)).astype(np.float32),
+        })
+        m1 = l.update(batch)
+        m2 = l2.update(batch)
+        assert abs(m1["critic_loss"] - m2["critic_loss"]) < 1e-5
+
+
+class TestSACEndToEnd:
+    def test_sac_learns_pendulum(self, rt):
+        """Random play on Pendulum scores ~ -1200; a learning SAC
+        reliably passes -900 within a few thousand env steps. The bar is
+        deliberately below tuned-final (~ -200) so seed noise can't flake
+        CI (mirrors the reference's pendulum-sac stop criterion)."""
+        from ray_tpu.rllib import SACConfig
+
+        algo = (SACConfig().environment("Pendulum-v1")
+                .rollouts(num_rollout_workers=1, num_envs_per_worker=8,
+                          rollout_fragment_length=32)
+                .training(train_batch_size=128, num_updates_per_iter=48,
+                          num_steps_sampled_before_learning_starts=512,
+                          lr=1e-3, critic_lr=1e-3, alpha_lr=1e-3)
+                .debugging(seed=0).build())
+        best = -1e9
+        for _ in range(100):
+            r = algo.train()
+            best = max(best, r.get("episode_reward_mean", -1e9))
+            if best >= -750.0:
+                break
+        algo.cleanup()
+        assert best >= -900.0, f"SAC failed to learn: best={best}"
