@@ -9,6 +9,10 @@ from ray_tpu.models.config import (
     llama3_70b_config,
     tiny_config,
 )
+# NOTE: the generate() function itself is not re-exported — it would
+# shadow the ray_tpu.models.generate submodule; use
+# ``from ray_tpu.models.generate import generate``.
+from ray_tpu.models.generate import decode_step, init_cache, prefill
 from ray_tpu.models.transformer import (
     forward,
     init_params,
@@ -28,6 +32,7 @@ __all__ = [
     "TransformerConfig", "get_config", "PRESETS", "tiny_config",
     "gpt2_small_config", "llama3_8b_config", "llama3_70b_config",
     "forward", "init_params", "loss_fn", "param_logical_axes",
+    "prefill", "decode_step", "init_cache",
     "make_optimizer", "make_train_step", "make_eval_step",
     "init_train_state", "state_shardings", "batch_sharding",
 ]

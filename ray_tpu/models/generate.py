@@ -1,0 +1,227 @@
+"""Autoregressive generation with a KV cache: prefill + decode.
+
+The inference half of the model stack (ref analog: the vLLM-backed
+``ray.serve`` LLM deployments and ``rayllm`` batched-generation path the
+reference ships for "Serve Llama-3 inference" — BASELINE.json configs).
+TPU-first design:
+
+  - Static shapes everywhere: the cache is allocated at ``max_len`` up
+    front and written with ``lax.dynamic_update_slice``; the decode loop
+    is a ``lax.scan`` over step index, so the whole generation of N
+    tokens is ONE compiled XLA program (no per-token Python dispatch).
+  - The layer dimension rides the same stacked-params ``lax.scan`` as
+    training (`transformer.forward`), so depth costs one trace and the
+    cache is a single [L, B, S, KV, hd] array per k/v — contiguous HBM,
+    no per-layer Python lists.
+  - Keys/values are cached *post-RoPE* and *pre-GQA-expansion* (KV heads,
+    not Q heads): memory scales with kv_heads, and the repeat to Q heads
+    happens inside the attention contraction.
+  - Decode attention is a dense masked contraction over the cache — at
+    T=1 per step it is HBM-bandwidth-bound (reads the cache once), which
+    is the TPU roofline for decode; batching raises MXU utilization.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.config import TransformerConfig
+from ray_tpu.models.transformer import Params, _rope, rms_norm
+
+KVCache = Dict[str, jax.Array]  # {"k": [L,B,S,KV,hd], "v": ..., "pos": []}
+
+# Large-finite instead of -inf for masked scores: a fully-masked query row
+# (a pad position in a left-padded batch) then softmaxes to uniform junk
+# instead of NaN — junk at pad positions is never attended (their keys are
+# masked) nor read (only real positions' logits are consumed), while NaN
+# would propagate through 0*NaN in the value contraction.
+_MASKED = jnp.float32(jnp.finfo(jnp.float32).min / 2)
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> KVCache:
+    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def _ffn(h, lp, cfg):
+    if cfg.moe_experts:
+        from ray_tpu.models.moe import moe_ffn
+
+        down, _ = moe_ffn(h, lp, cfg, None)
+        return down
+    gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(cfg.dtype))
+    up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(cfg.dtype))
+    return jnp.einsum("btf,fd->btd", jax.nn.silu(gate) * up,
+                      lp["w_down"].astype(cfg.dtype))
+
+
+def _qkv(h, lp, cfg, positions):
+    q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(cfg.dtype))
+    k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(cfg.dtype))
+    v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(cfg.dtype))
+    return (_rope(q, positions, cfg.rope_theta),
+            _rope(k, positions, cfg.rope_theta), v)
+
+
+def _gqa_attention(q, k, v, mask):
+    """q [B,T,H,hd] vs keys/values [B,S,KV,hd] under a broadcastable
+    mask [B,T,1,1,S]. GQA expansion happens by reshaping q into
+    [KV, reps] groups — no materialized repeat of k/v."""
+    B, T, H, hd = q.shape
+    KV = k.shape[2]
+    reps = H // KV
+    qg = q.reshape(B, T, KV, reps, hd)
+    scores = jnp.einsum("btkrh,bskh->btkrs", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * (hd ** -0.5)
+    scores = jnp.where(mask, scores, _MASKED)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("btkrs,bskh->btkrh", probs, v.astype(jnp.float32))
+    return o.reshape(B, T, H, hd).astype(q.dtype)
+
+
+def _cached_attention(q, k_cache, v_cache, valid_len, start):
+    """Decode attention against the full cache, masking key positions
+    outside [start[b], valid_len). ``start`` [B] supports left-padded
+    batches (pad tokens are never attended; RoPE is relative, so the
+    absolute offset is harmless)."""
+    S = k_cache.shape[1]
+    kpos = jnp.arange(S)[None, None, None, None, :]
+    mask = (kpos < valid_len) & \
+        (kpos >= start[:, None, None, None, None])
+    return _gqa_attention(q, k_cache, v_cache, mask)
+
+
+def _final_logits(params, x, cfg):
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    return jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
+                      head.astype(jnp.float32))
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_len"))
+def prefill(params: Params, tokens: jax.Array, cfg: TransformerConfig,
+            max_len: int, start: Optional[jax.Array] = None
+            ) -> Tuple[jax.Array, KVCache]:
+    """Process the whole prompt [B, P] in one pass; -> (logits [B,P,V],
+    cache filled at positions [0, P)). ``start`` [B] marks the first
+    REAL token per row for left-padded batches (earlier positions are
+    masked out of attention)."""
+    B, P = tokens.shape
+    if max_len < P:
+        raise ValueError(f"max_len={max_len} < prompt length {P}")
+    if start is None:
+        start = jnp.zeros((B,), jnp.int32)
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    positions = jnp.arange(P)
+
+    causal = jnp.arange(P)[:, None] >= jnp.arange(P)[None, :]
+    valid = jnp.arange(P)[None, :] >= start[:, None]  # [B, S]
+    prompt_mask = causal[None, :, None, None, :] & \
+        valid[:, None, None, None, :]
+
+    def block(x, lp):
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(h, lp, cfg, positions)
+        o = _gqa_attention(q, k, v, prompt_mask)
+        o = jnp.einsum("bthk,hkd->btd", o, lp["wo"].astype(cfg.dtype))
+        x = x + o
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        x = x + _ffn(h, lp, cfg)
+        # pad this layer's k/v out to max_len for the cache
+        pad = [(0, 0), (0, max_len - P), (0, 0), (0, 0)]
+        return x, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+    x, (k_all, v_all) = jax.lax.scan(block, x, params["layers"])
+    cache = {"k": k_all, "v": v_all,
+             "pos": jnp.asarray(P, jnp.int32)}
+    return _final_logits(params, x, cfg), cache
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def decode_step(params: Params, cache: KVCache, tokens: jax.Array,
+                cfg: TransformerConfig,
+                start: Optional[jax.Array] = None
+                ) -> Tuple[jax.Array, KVCache]:
+    """One token per sequence: tokens [B] at position cache['pos'];
+    -> (logits [B, V], cache advanced by one)."""
+    pos = cache["pos"]
+    if start is None:
+        start = jnp.zeros((tokens.shape[0],), jnp.int32)
+    x = params["embed"].astype(cfg.dtype)[tokens[:, None]]  # [B,1,d]
+    positions = pos[None]  # [1]
+
+    def block(x, scanned):
+        lp, k_layer, v_layer = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(h, lp, cfg, positions)
+        B = x.shape[0]
+        k_layer = jax.lax.dynamic_update_slice(
+            k_layer, k.astype(k_layer.dtype), (0, pos, 0, 0))
+        v_layer = jax.lax.dynamic_update_slice(
+            v_layer, v.astype(v_layer.dtype), (0, pos, 0, 0))
+        o = _cached_attention(q, k_layer, v_layer, pos + 1, start)
+        o = jnp.einsum("bthk,hkd->btd", o, lp["wo"].astype(cfg.dtype))
+        x = x + o
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        x = x + _ffn(h, lp, cfg)
+        return x, (k_layer, v_layer)
+
+    x, (k_all, v_all) = jax.lax.scan(
+        block, x, (params["layers"], cache["k"], cache["v"]))
+    new_cache = {"k": k_all, "v": v_all, "pos": pos + 1}
+    return _final_logits(params, x, cfg)[:, 0], new_cache
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "max_new_tokens", "max_len", "greedy"))
+def generate(params: Params, prompt: jax.Array, cfg: TransformerConfig,
+             *, max_new_tokens: int, max_len: Optional[int] = None,
+             temperature: float = 1.0, greedy: bool = True,
+             eos_id: int = -1, rng: Optional[jax.Array] = None,
+             start: Optional[jax.Array] = None) -> jax.Array:
+    """prompt [B, P] -> [B, P + max_new_tokens]. One compiled program:
+    prefill, then a lax.scan of decode steps (greedy or temperature
+    sampling). Sequences that hit ``eos_id`` keep emitting eos.
+    ``start`` [B]: first real-token position per row (left-padded
+    batches of unequal prompt lengths)."""
+    B, P = prompt.shape
+    S = max_len or (P + max_new_tokens)
+    if S < P + max_new_tokens:
+        # an undersized cache would silently clamp dynamic_update_slice
+        # writes onto the last slot and corrupt attention — refuse
+        raise ValueError(
+            f"max_len={S} < prompt_len({P}) + max_new_tokens"
+            f"({max_new_tokens}); the KV cache must hold every position")
+    if rng is None:
+        rng = jax.random.key(0)
+    if start is None:
+        start = jnp.zeros((B,), jnp.int32)
+    logits, cache = prefill(params, prompt, cfg, S, start)
+    last = logits[:, -1]
+
+    def pick(logits, step_rng):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(
+            step_rng, logits / jnp.maximum(temperature, 1e-6)
+        ).astype(prompt.dtype)
+
+    def step(carry, step_rng):
+        cache, last_logits, done = carry
+        tok = pick(last_logits, step_rng)
+        tok = jnp.where(done, jnp.asarray(eos_id, tok.dtype), tok)
+        done = done | (tok == eos_id)
+        logits, cache = decode_step(params, cache, tok, cfg, start)
+        return (cache, logits, done), tok
+
+    done0 = jnp.zeros((B,), jnp.bool_)
+    (_, _, _), toks = jax.lax.scan(
+        step, (cache, last, done0),
+        jax.random.split(rng, max_new_tokens))
+    return jnp.concatenate([prompt, toks.T], axis=1)

@@ -192,6 +192,7 @@ class ContinuousRolloutWorker:
 
         self.vec = VectorEnv(env_creator, num_envs, seed=seed * 1000 + 17)
         assert self.vec.continuous, "use RolloutWorker for discrete envs"
+        self._env_creator = env_creator
         env0 = self.vec.envs[0]
         self.policy = SquashedGaussianPolicy(
             self.vec.observation_dim, self.vec.action_dim,
@@ -225,10 +226,12 @@ class ContinuousRolloutWorker:
                                     (A,), np.float32)
 
     def evaluate(self, num_episodes: int = 5, seed: int = 0) -> dict:
-        """Deterministic (mean-action) eval on fresh env copies."""
+        """Deterministic (mean-action) eval on a fresh env from the SAME
+        creator the rollouts use (a configured creator must configure the
+        eval env identically)."""
         from .env import make_env
 
-        env = make_env(self.vec.envs[0].__class__)
+        env = make_env(self._env_creator)
         returns = []
         for ep in range(num_episodes):
             obs = env.reset(seed=10_000 + seed * 100 + ep)

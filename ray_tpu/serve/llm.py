@@ -1,0 +1,127 @@
+"""LLM serving: a batched autoregressive-generation deployment.
+
+Ref analog: the reference's Serve LLM path (python/ray/serve + the
+"Ray Serve: Llama-3 inference deployment (batched)" BASELINE.json
+config, served there via vLLM-on-GPU workers). TPU-first re-design:
+replicas hold jitted prefill/decode programs from
+``ray_tpu.models.generate`` — the KV cache is preallocated at a static
+``max_len`` so every batch shape compiles once — and ``@serve.batch``
+coalesces concurrent single-prompt requests into one [B, P] generate
+call that keeps the MXU busy. Prompts are right-aligned into a fixed
+bucket (static shapes; XLA never recompiles per request).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.deployment import deployment
+
+
+class _LLMReplica:
+    """Replica body: owns params + jitted generate for one model config.
+
+    ``model`` is a config name from ``ray_tpu.models.config.get_config``
+    (e.g. "gpt2-small", "llama3-1b") or a TransformerConfig; weights are
+    randomly initialized unless ``checkpoint_dir`` (an orbax/pickle tree
+    saved by train) is given — serving infrastructure is what's under
+    test here, not weights.
+    """
+
+    def __init__(self, model="tiny", *, max_batch_size: int = 8,
+                 max_prompt_len: int = 64, max_new_tokens: int = 32,
+                 batch_wait_timeout_s: float = 0.02,
+                 checkpoint_dir: Optional[str] = None,
+                 greedy: bool = True, temperature: float = 1.0,
+                 pad_id: int = 0, seed: int = 0):
+        import jax
+
+        from ray_tpu.models.config import TransformerConfig, get_config
+        from ray_tpu.models.transformer import init_params
+
+        cfg = (model if isinstance(model, TransformerConfig)
+               else get_config(model))
+        self.cfg = cfg
+        self.max_new_tokens = int(max_new_tokens)
+        self.max_prompt_len = int(max_prompt_len)
+        self.greedy = greedy
+        self.temperature = float(temperature)
+        self.pad_id = int(pad_id)
+        self._rng = jax.random.key(seed)
+        if checkpoint_dir is not None:
+            import pickle
+
+            with open(checkpoint_dir, "rb") as f:
+                self.params = jax.tree.map(np.asarray, pickle.load(f))
+        else:
+            self.params = init_params(jax.random.key(seed), cfg)
+        self._max_bs = int(max_batch_size)
+        # the batcher cap and the compiled batch shape MUST be the same
+        # number, so the batcher is built per-instance from the
+        # constructor arg (a class-level @batch would freeze its own cap)
+        self.generate_batch = batch(
+            max_batch_size=self._max_bs,
+            batch_wait_timeout_s=batch_wait_timeout_s)(self._generate)
+
+    def _pad_batch(self, prompts: Sequence[Sequence[int]]):
+        """Left-pad to the bucket so the last prompt token sits at the
+        cache's write position for every row; returns (tokens [B,P],
+        start [B]) where start marks each row's first real token (pad
+        positions are masked out of attention by generate)."""
+        P = self.max_prompt_len
+        out = np.full((len(prompts), P), self.pad_id, np.int32)
+        start = np.zeros(len(prompts), np.int32)
+        for i, p in enumerate(prompts):
+            p = list(p)  # oversized prompts were rejected in __call__
+            out[i, P - len(p):] = p
+            start[i] = P - len(p)
+        return out, start
+
+    def _generate(self, prompts: List[Sequence[int]]) -> List[dict]:
+        import jax
+
+        from ray_tpu.models.generate import generate
+
+        toks, start = self._pad_batch(prompts)
+        # pad the BATCH to the compiled size too: one XLA program total
+        B = toks.shape[0]
+        if B < self._max_bs:
+            toks_full = np.resize(toks, (self._max_bs, toks.shape[1]))
+            start_full = np.resize(start, (self._max_bs,))
+        else:
+            toks_full, start_full = toks, start
+        self._rng, sub = jax.random.split(self._rng)
+        out = generate(self.params, toks_full, self.cfg,
+                       max_new_tokens=self.max_new_tokens,
+                       greedy=self.greedy, temperature=self.temperature,
+                       rng=sub, start=start_full)
+        out = np.asarray(out)[:B, toks.shape[1]:]
+        return [{"token_ids": row.tolist()} for row in out]
+
+    def __call__(self, prompt: Sequence[int]) -> dict:
+        if len(prompt) > self.max_prompt_len:
+            # refuse rather than silently conditioning on a clipped
+            # prompt; the per-request check keeps one oversized prompt
+            # from failing a whole coalesced batch
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds this deployment's "
+                f"max_prompt_len={self.max_prompt_len}")
+        return self.generate_batch(prompt)
+
+
+def build_llm_deployment(model="tiny", *, name: str = "llm",
+                         num_replicas: int = 1, **replica_kwargs):
+    """-> an Application serving ``{prompt token ids} -> {token_ids}``.
+
+    Usage::
+
+        app = build_llm_deployment("gpt2-small", max_new_tokens=16)
+        handle = serve.run(app, name="llm")
+        out = handle.remote([1, 2, 3]).result()
+    """
+    dep = deployment(_LLMReplica, name=name) \
+        .options(num_replicas=num_replicas)
+    return dep.bind(model, **replica_kwargs)

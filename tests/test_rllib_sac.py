@@ -161,6 +161,25 @@ class TestSACLearner:
         assert abs(m1["critic_loss"] - m2["critic_loss"]) < 1e-5
 
 
+class TestContinuousWorker:
+    def test_evaluate_uses_the_creator(self):
+        """evaluate() builds its eval env from the SAME creator as the
+        rollouts, so a configured creator configures eval too."""
+        from ray_tpu.rllib import Pendulum
+        from ray_tpu.rllib.rollout_worker import ContinuousRolloutWorker
+
+        made = []
+
+        def creator():
+            made.append(1)
+            return Pendulum()
+
+        w = ContinuousRolloutWorker(creator, 2, 8, 0.99, 0.95, seed=0)
+        out = w.evaluate(num_episodes=2)
+        assert len(out["returns"]) == 2 and out["mean_return"] < 0
+        assert len(made) == 3  # 2 vec envs + 1 eval env
+
+
 class TestSACEndToEnd:
     def test_sac_learns_pendulum(self, rt):
         """Random play on Pendulum scores ~ -1200; a learning SAC
