@@ -3,12 +3,14 @@
 from ray_tpu.models.config import (
     PRESETS,
     TransformerConfig,
+    bert_base_config,
     get_config,
     gpt2_small_config,
     llama3_8b_config,
     llama3_70b_config,
     tiny_config,
 )
+from ray_tpu.models.mlm import mask_tokens
 # NOTE: the generate() function itself is not re-exported — it would
 # shadow the ray_tpu.models.generate submodule; use
 # ``from ray_tpu.models.generate import generate``.
@@ -31,6 +33,7 @@ from ray_tpu.models.training import (
 __all__ = [
     "TransformerConfig", "get_config", "PRESETS", "tiny_config",
     "gpt2_small_config", "llama3_8b_config", "llama3_70b_config",
+    "bert_base_config", "mask_tokens",
     "forward", "init_params", "loss_fn", "param_logical_axes",
     "prefill", "decode_step", "init_cache",
     "make_optimizer", "make_train_step", "make_eval_step",

@@ -30,6 +30,9 @@ class TransformerConfig:
     dtype: jnp.dtype = jnp.bfloat16        # activation/compute dtype
     param_dtype: jnp.dtype = jnp.float32   # master weights
     tie_embeddings: bool = False
+    # False -> bidirectional (encoder / BERT-class) attention; the same
+    # blocks, RoPE, and loss_fn (inputs/targets/mask form = MLM) apply.
+    causal: bool = True
     remat: bool = True                     # checkpoint each layer (HBM <-> FLOPs)
     # "nothing": rematerialize everything (min HBM); "dots": save matmul
     # outputs, recompute elementwise only (less recompute FLOPs -> higher
@@ -120,8 +123,25 @@ def llama3_70b_config(**kw) -> TransformerConfig:
     return TransformerConfig(**base)
 
 
+def bert_base_config(**kw) -> TransformerConfig:
+    """BERT-base-scale bidirectional encoder (110M class): same blocks
+    as the decoders but ``causal=False``; train with ``loss_fn`` in its
+    inputs/targets/mask form (= masked-language-model objective, see
+    models.mlm). Ref analog: the reference's BERT-base data-parallel
+    TorchTrainer benchmark config (BASELINE.md)."""
+    # d_ff=2048 keeps the 3-matrix SwiGLU FFN at BERT's 2-matrix-GELU
+    # parameter budget (3*768*2048 ≈ 2*768*3072), so the preset stays
+    # a 110M-class model
+    base = dict(vocab_size=30_522, d_model=768, n_layers=12, n_heads=12,
+                d_ff=2048, max_seq_len=512, causal=False,
+                tie_embeddings=True)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
 PRESETS = {
     "tiny": tiny_config,
+    "bert-base": bert_base_config,
     "gpt2-small": gpt2_small_config,
     "llama3-1b": llama3_1b_config,
     "llama3-8b": llama3_8b_config,

@@ -30,7 +30,8 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.models.config import TransformerConfig
-from ray_tpu.models.transformer import Params, _rope, rms_norm
+from ray_tpu.models.transformer import (Params, ffn_block, lm_head,
+                                        qkv_proj, rms_norm)
 
 KVCache = Dict[str, jax.Array]  # {"k": [L,B,S,KV,hd], "v": ..., "pos": []}
 
@@ -50,23 +51,10 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> KVCache:
 
 
 def _ffn(h, lp, cfg):
-    if cfg.moe_experts:
-        from ray_tpu.models.moe import moe_ffn
-
-        down, _ = moe_ffn(h, lp, cfg, None)
-        return down
-    gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(cfg.dtype))
-    up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(cfg.dtype))
-    return jnp.einsum("btf,fd->btd", jax.nn.silu(gate) * up,
-                      lp["w_down"].astype(cfg.dtype))
-
-
-def _qkv(h, lp, cfg, positions):
-    q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(cfg.dtype))
-    k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(cfg.dtype))
-    v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(cfg.dtype))
-    return (_rope(q, positions, cfg.rope_theta),
-            _rope(k, positions, cfg.rope_theta), v)
+    # shared definition with the training path (transformer.ffn_block);
+    # inference drops the MoE aux loss
+    down, _ = ffn_block(h, lp, cfg, None)
+    return down
 
 
 def _gqa_attention(q, k, v, mask):
@@ -98,20 +86,17 @@ def _cached_attention(q, k_cache, v_cache, valid_len, start):
 
 
 def _final_logits(params, x, cfg):
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
-    return jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
-                      head.astype(jnp.float32))
+    # shared final norm + head with the training path
+    return lm_head(params, x, cfg, None)
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_len"))
-def prefill(params: Params, tokens: jax.Array, cfg: TransformerConfig,
-            max_len: int, start: Optional[jax.Array] = None
-            ) -> Tuple[jax.Array, KVCache]:
-    """Process the whole prompt [B, P] in one pass; -> (logits [B,P,V],
-    cache filled at positions [0, P)). ``start`` [B] marks the first
-    REAL token per row for left-padded batches (earlier positions are
-    masked out of attention)."""
+def _prefill_hidden(params: Params, tokens: jax.Array,
+                    cfg: TransformerConfig, max_len: int,
+                    start: jax.Array):
+    """Prompt pass returning final HIDDEN states [B,P,d] + the filled
+    cache — generate() projects only the last position to vocab space
+    (a [B,P,V] float32 logits tensor is ~2 GB for llama3-8b at P=512
+    and is pure waste on the serving hot path)."""
     B, P = tokens.shape
     if max_len < P:
         raise ValueError(f"max_len={max_len} < prompt length {P}")
@@ -127,7 +112,7 @@ def prefill(params: Params, tokens: jax.Array, cfg: TransformerConfig,
 
     def block(x, lp):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q, k, v = _qkv(h, lp, cfg, positions)
+        q, k, v = qkv_proj(h, lp, cfg, positions)
         o = _gqa_attention(q, k, v, prompt_mask)
         o = jnp.einsum("bthk,hkd->btd", o, lp["wo"].astype(cfg.dtype))
         x = x + o
@@ -140,6 +125,20 @@ def prefill(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     x, (k_all, v_all) = jax.lax.scan(block, x, params["layers"])
     cache = {"k": k_all, "v": v_all,
              "pos": jnp.asarray(P, jnp.int32)}
+    return x, cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_len"))
+def prefill(params: Params, tokens: jax.Array, cfg: TransformerConfig,
+            max_len: int, start: Optional[jax.Array] = None
+            ) -> Tuple[jax.Array, KVCache]:
+    """Process the whole prompt [B, P] in one pass; -> (logits [B,P,V],
+    cache filled at positions [0, P)). ``start`` [B] marks the first
+    REAL token per row for left-padded batches (earlier positions are
+    masked out of attention)."""
+    if start is None:
+        start = jnp.zeros((tokens.shape[0],), jnp.int32)
+    x, cache = _prefill_hidden(params, tokens, cfg, max_len, start)
     return _final_logits(params, x, cfg), cache
 
 
@@ -159,7 +158,7 @@ def decode_step(params: Params, cache: KVCache, tokens: jax.Array,
     def block(x, scanned):
         lp, k_layer, v_layer = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q, k, v = _qkv(h, lp, cfg, positions)
+        q, k, v = qkv_proj(h, lp, cfg, positions)
         B = x.shape[0]
         k_layer = jax.lax.dynamic_update_slice(
             k_layer, k.astype(k_layer.dtype), (0, pos, 0, 0))
@@ -202,8 +201,10 @@ def generate(params: Params, prompt: jax.Array, cfg: TransformerConfig,
         rng = jax.random.key(0)
     if start is None:
         start = jnp.zeros((B,), jnp.int32)
-    logits, cache = prefill(params, prompt, cfg, S, start)
-    last = logits[:, -1]
+    x, cache = _prefill_hidden(params, prompt, cfg, S, start)
+    # only the LAST position's logits seed decoding: project [B,1,d]
+    # instead of materializing the full [B,P,V] prompt logits
+    last = _final_logits(params, x[:, -1:], cfg)[:, 0]
 
     def pick(logits, step_rng):
         if greedy:

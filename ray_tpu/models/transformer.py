@@ -137,11 +137,46 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh],
                positions):
     impl = _select_attention(cfg, mesh)
     if impl == "ring":
-        return ring_attention(q, k, v, mesh, causal=True)
+        return ring_attention(q, k, v, mesh, causal=cfg.causal)
     if impl == "pallas":
         from ray_tpu.ops import flash_attention  # lazy: pallas import cost
-        return flash_attention(q, k, v, causal=True)
-    return reference_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=cfg.causal)
+    return reference_attention(q, k, v, causal=cfg.causal)
+
+
+def qkv_proj(h, lp, cfg: TransformerConfig, positions):
+    """Q/K/V projections + RoPE — the single definition shared by the
+    training forward and the KV-cache inference path (models/generate),
+    so a numeric change (e.g. QK-norm) lands in both."""
+    q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(cfg.dtype))
+    k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(cfg.dtype))
+    v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(cfg.dtype))
+    return (_rope(q, positions, cfg.rope_theta),
+            _rope(k, positions, cfg.rope_theta), v)
+
+
+def ffn_block(h, lp, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
+    """SwiGLU (or MoE) FFN -> (down, aux); shared by train + inference."""
+    if cfg.moe_experts:
+        from ray_tpu.models.moe import moe_ffn
+
+        return moe_ffn(h, lp, cfg, mesh)
+    gate = jnp.einsum("btd,df->btf", h, lp["w_gate"].astype(cfg.dtype))
+    up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(cfg.dtype))
+    ff = jax.nn.silu(gate) * up
+    ff = _wlc(ff, ("batch", "seq", "mlp"), mesh=mesh)
+    down = jnp.einsum("btf,fd->btd", ff, lp["w_down"].astype(cfg.dtype))
+    return down, jnp.zeros((), jnp.float32)
+
+
+def lm_head(params: Params, x, cfg: TransformerConfig,
+            mesh: Optional[Mesh] = None):
+    """Final norm + (tied or separate) vocabulary projection."""
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
+                        head.astype(jnp.float32))
+    return _wlc(logits, ("batch", "seq", "vocab"), mesh=mesh)
 
 
 # ---- forward ---------------------------------------------------------------
@@ -159,11 +194,7 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
 
     def block(x, lp):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(cfg.dtype))
-        k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(cfg.dtype))
-        v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(cfg.dtype))
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        q, k, v = qkv_proj(h, lp, cfg, positions)
         reps = cfg.n_heads // cfg.kv_heads
         if reps > 1:  # GQA: expand kv heads to match q heads
             k = jnp.repeat(k, reps, axis=2)
@@ -174,19 +205,7 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
         x = x + _wlc(o, ("batch", "seq", "embed"), mesh=mesh)
 
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        if cfg.moe_experts:
-            from ray_tpu.models.moe import moe_ffn
-
-            down, aux = moe_ffn(h, lp, cfg, mesh)
-        else:
-            gate = jnp.einsum("btd,df->btf", h,
-                              lp["w_gate"].astype(cfg.dtype))
-            up = jnp.einsum("btd,df->btf", h, lp["w_up"].astype(cfg.dtype))
-            ff = jax.nn.silu(gate) * up
-            ff = _wlc(ff, ("batch", "seq", "mlp"), mesh=mesh)
-            down = jnp.einsum("btf,fd->btd", ff,
-                              lp["w_down"].astype(cfg.dtype))
-            aux = jnp.zeros((), jnp.float32)
+        down, aux = ffn_block(h, lp, cfg, mesh)
         x = x + _wlc(down, ("batch", "seq", "embed"), mesh=mesh)
         # aux (MoE load-balance loss) rides the scan's per-layer outputs;
         # the pipelined path drops it (pipeline stages emit activations
@@ -214,11 +233,7 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
             lambda c, lp: body(c, lp), x, params["layers"])
         aux = layer_aux.sum()
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
-    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
-                        head.astype(jnp.float32))
-    logits = _wlc(logits, ("batch", "seq", "vocab"), mesh=mesh)
+    logits = lm_head(params, x, cfg, mesh)
     return (logits, aux) if return_aux else logits
 
 

@@ -17,7 +17,6 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from ray_tpu.serve.batching import batch
 from ray_tpu.serve.deployment import deployment
 
 
@@ -61,10 +60,12 @@ class _LLMReplica:
         self._max_bs = int(max_batch_size)
         # the batcher cap and the compiled batch shape MUST be the same
         # number, so the batcher is built per-instance from the
-        # constructor arg (a class-level @batch would freeze its own cap)
-        self.generate_batch = batch(
-            max_batch_size=self._max_bs,
-            batch_wait_timeout_s=batch_wait_timeout_s)(self._generate)
+        # constructor arg (a class-level @serve.batch would freeze its
+        # own cap). Held on self — not the module-global registry — so
+        # replica teardown releases the params it closes over.
+        from ray_tpu.serve.batching import _Batcher
+
+        self._batcher = _Batcher(self._max_bs, batch_wait_timeout_s)
 
     def _pad_batch(self, prompts: Sequence[Sequence[int]]):
         """Left-pad to the bucket so the last prompt token sits at the
@@ -109,7 +110,7 @@ class _LLMReplica:
             raise ValueError(
                 f"prompt length {len(prompt)} exceeds this deployment's "
                 f"max_prompt_len={self.max_prompt_len}")
-        return self.generate_batch(prompt)
+        return self._batcher.submit(self._generate, prompt)
 
 
 def build_llm_deployment(model="tiny", *, name: str = "llm",
