@@ -100,8 +100,11 @@ def _prefill_hidden(params: Params, tokens: jax.Array,
     B, P = tokens.shape
     if max_len < P:
         raise ValueError(f"max_len={max_len} < prompt length {P}")
-    if start is None:
-        start = jnp.zeros((B,), jnp.int32)
+    if not cfg.causal:
+        # autoregressive decoding over a bidirectional encoder would
+        # silently contradict the forward() the params were trained with
+        raise ValueError("generation requires a causal (decoder) config; "
+                         "this config has causal=False")
     x = params["embed"].astype(cfg.dtype)[tokens]
     positions = jnp.arange(P)
 

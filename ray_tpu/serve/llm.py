@@ -49,6 +49,12 @@ class _LLMReplica:
         self.greedy = greedy
         self.temperature = float(temperature)
         self.pad_id = int(pad_id)
+        import threading
+
+        # stream() runs on caller threads while _generate runs on the
+        # batcher's drainer thread: key handout must be atomic or two
+        # concurrent sampling requests split the same key
+        self._rng_lock = threading.Lock()
         self._rng = jax.random.key(seed)
         if checkpoint_dir is not None:
             import pickle
@@ -81,9 +87,14 @@ class _LLMReplica:
             start[i] = P - len(p)
         return out, start
 
-    def _generate(self, prompts: List[Sequence[int]]) -> List[dict]:
+    def _next_rng(self):
         import jax
 
+        with self._rng_lock:
+            self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _generate(self, prompts: List[Sequence[int]]) -> List[dict]:
         from ray_tpu.models.generate import generate
 
         toks, start = self._pad_batch(prompts)
@@ -94,13 +105,52 @@ class _LLMReplica:
             start_full = np.resize(start, (self._max_bs,))
         else:
             toks_full, start_full = toks, start
-        self._rng, sub = jax.random.split(self._rng)
         out = generate(self.params, toks_full, self.cfg,
                        max_new_tokens=self.max_new_tokens,
                        greedy=self.greedy, temperature=self.temperature,
-                       rng=sub, start=start_full)
+                       rng=self._next_rng(), start=start_full)
         out = np.asarray(out)[:B, toks.shape[1]:]
         return [{"token_ids": row.tolist()} for row in out]
+
+    def stream(self, prompt: Sequence[int]):
+        """Token-by-token generation: a generator the router streams back
+        chunk-wise (``handle.options(method_name='stream', stream=True)``
+        or chunked HTTP). Per-request B=1 decode via the stepwise
+        prefill/decode_step API — streaming trades the batched program
+        for first-token latency, the same trade the reference's streaming
+        LLM responses make (serve/_private/replica.py generator path)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.generate import decode_step, prefill
+
+        if len(prompt) > self.max_prompt_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds this deployment's "
+                f"max_prompt_len={self.max_prompt_len}")
+        # left-pad into the same fixed bucket as the batched path: ONE
+        # compiled (prefill, decode) shape per deployment, not one per
+        # distinct prompt length
+        P = self.max_prompt_len
+        toks = np.full((1, P), self.pad_id, np.int32)
+        toks[0, P - len(prompt):] = list(prompt)
+        start = jnp.asarray([P - len(prompt)], jnp.int32)
+        toks = jnp.asarray(toks)
+        max_len = P + self.max_new_tokens
+        logits, cache = prefill(self.params, toks, self.cfg, max_len,
+                                start)
+        last = logits[:, -1]
+        for i in range(self.max_new_tokens):
+            if self.greedy:
+                tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            else:
+                tok = jax.random.categorical(
+                    self._next_rng(), last / max(self.temperature, 1e-6)
+                ).astype(jnp.int32)
+            yield {"token_id": int(tok[0])}
+            if i + 1 < self.max_new_tokens:  # last step has no consumer
+                last, cache = decode_step(self.params, cache, tok,
+                                          self.cfg, start)
 
     def __call__(self, prompt: Sequence[int]) -> dict:
         if len(prompt) > self.max_prompt_len:

@@ -110,6 +110,15 @@ class TestGenerate:
         with pytest.raises(ValueError, match="max_len"):
             G.prefill(params, prompt, cfg, 4)
 
+    def test_encoder_config_rejected(self, tiny):
+        """Autoregressive decoding over a causal=False encoder would
+        silently contradict its bidirectional training forward."""
+        cfg, params = tiny
+        enc = dataclasses.replace(cfg, causal=False)
+        with pytest.raises(ValueError, match="causal"):
+            G.generate(params, jnp.zeros((1, 4), jnp.int32), enc,
+                       max_new_tokens=2)
+
     def test_sampled_generation_respects_temperature_rng(self, tiny):
         cfg, params = tiny
         prompt = jax.random.randint(jax.random.key(1), (2, 4), 0,
@@ -153,6 +162,22 @@ class TestServeLLM:
         # clipped (and don't poison the coalesced batch)
         with pytest.raises(Exception, match="max_prompt_len"):
             handle.remote(list(range(20))).result(timeout_s=120)
+
+    def test_streaming_tokens_match_batched(self, serve_rt):
+        """stream() yields the same greedy tokens one at a time that the
+        batched __call__ path returns all at once."""
+        serve = serve_rt
+        from ray_tpu.serve.llm import build_llm_deployment
+
+        app = build_llm_deployment(
+            "tiny", name="llm_s", max_prompt_len=8, max_new_tokens=4,
+            max_batch_size=4)
+        handle = serve.run(app, name="llm_s")
+        batched = handle.remote([1, 2, 3]).result(timeout_s=120)
+        gen = handle.options(method_name="stream",
+                             stream=True).remote([1, 2, 3])
+        streamed = [chunk["token_id"] for chunk in gen]
+        assert streamed == batched["token_ids"]
 
     def test_batcher_cap_matches_compiled_shape(self, serve_rt):
         """max_batch_size below the @batch default (8) must still cap
