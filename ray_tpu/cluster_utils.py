@@ -162,24 +162,29 @@ class NodeKiller:
             idx, node = victim
             total = node.resources.total.to_dict()
             labels = dict(node.resources.labels)
+            topology = node.resources.tpu
             self._cluster.remove_node(idx)
             self.kills.append((time.monotonic(), idx, "logical"))
             if self._respawn:
                 # replacement preserves the victim's FULL resource set —
-                # CPU/TPU/memory plus custom resources — so cluster
-                # capacity holds steady through the chaos run
+                # CPU/TPU/memory plus custom resources AND tpu topology,
+                # so topology-aware (STRICT_PACK) workloads can still
+                # reschedule and cluster capacity holds steady. CPU/TPU
+                # pass through unrounded: the resource model is
+                # fixed-point, so fractional grants survive respawn.
                 custom = {k: v for k, v in total.items()
                           if k not in ("CPU", "TPU", "memory",
                                        "object_store_memory")}
                 self._cluster.add_node(
-                    num_cpus=int(total.get("CPU", 0)),
-                    num_tpus=int(total.get("TPU", 0)),
+                    num_cpus=total.get("CPU", 0),
+                    num_tpus=total.get("TPU", 0),
                     memory=total.get("memory"),
                     object_store_memory=(
                         int(total["object_store_memory"])
                         if "object_store_memory" in total else None),
                     resources=custom or None,
-                    labels=labels or None)
+                    labels=labels or None,
+                    tpu_topology=topology)
         else:
             victim.terminate()
             self.kills.append((time.monotonic(), victim.node_idx, "remote"))

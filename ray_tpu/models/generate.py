@@ -204,6 +204,8 @@ def generate(params: Params, prompt: jax.Array, cfg: TransformerConfig,
         rng = jax.random.key(0)
     if start is None:
         start = jnp.zeros((B,), jnp.int32)
+    if max_new_tokens == 0:  # static arg: a free Python-level branch
+        return prompt
     x, cache = _prefill_hidden(params, prompt, cfg, S, start)
     # only the LAST position's logits seed decoding: project [B,1,d]
     # instead of materializing the full [B,P,V] prompt logits
@@ -216,16 +218,22 @@ def generate(params: Params, prompt: jax.Array, cfg: TransformerConfig,
             step_rng, logits / jnp.maximum(temperature, 1e-6)
         ).astype(prompt.dtype)
 
+    # The first token comes straight from the prefill logits; the scan
+    # then runs max_new_tokens-1 decode steps, each decoding the PREVIOUS
+    # token and sampling the next — so the final sampled token never pays
+    # for a decode_step whose logits nobody reads.
+    rngs = jax.random.split(rng, max_new_tokens)
+    tok0 = pick(last, rngs[0])
+    done0 = tok0 == eos_id
+
     def step(carry, step_rng):
-        cache, last_logits, done = carry
-        tok = pick(last_logits, step_rng)
+        cache, prev_tok, done = carry
+        logits, cache = decode_step(params, cache, prev_tok, cfg, start)
+        tok = pick(logits, step_rng)
         tok = jnp.where(done, jnp.asarray(eos_id, tok.dtype), tok)
         done = done | (tok == eos_id)
-        logits, cache = decode_step(params, cache, tok, cfg, start)
-        return (cache, logits, done), tok
+        return (cache, tok, done), tok
 
-    done0 = jnp.zeros((B,), jnp.bool_)
-    (_, _, _), toks = jax.lax.scan(
-        step, (cache, last, done0),
-        jax.random.split(rng, max_new_tokens))
+    (_, _, _), toks = jax.lax.scan(step, (cache, tok0, done0), rngs[1:])
+    toks = jnp.concatenate([tok0[None], toks], axis=0)  # [N, B]
     return jnp.concatenate([prompt, toks.T], axis=1)

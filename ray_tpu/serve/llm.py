@@ -35,7 +35,7 @@ class _LLMReplica:
                  batch_wait_timeout_s: float = 0.02,
                  checkpoint_dir: Optional[str] = None,
                  greedy: bool = True, temperature: float = 1.0,
-                 pad_id: int = 0, seed: int = 0):
+                 pad_id: int = 0, eos_id: int = -1, seed: int = 0):
         import jax
 
         from ray_tpu.models.config import TransformerConfig, get_config
@@ -49,6 +49,10 @@ class _LLMReplica:
         self.greedy = greedy
         self.temperature = float(temperature)
         self.pad_id = int(pad_id)
+        # -1 (never sampled for non-negative vocabularies) disables the
+        # eos freeze; when set, generate() stops extending finished rows
+        # and stream() ends at the model's natural stop
+        self.eos_id = int(eos_id)
         import threading
 
         # stream() runs on caller threads while _generate runs on the
@@ -108,9 +112,18 @@ class _LLMReplica:
         out = generate(self.params, toks_full, self.cfg,
                        max_new_tokens=self.max_new_tokens,
                        greedy=self.greedy, temperature=self.temperature,
-                       rng=self._next_rng(), start=start_full)
+                       eos_id=self.eos_id, rng=self._next_rng(),
+                       start=start_full)
         out = np.asarray(out)[:B, toks.shape[1]:]
-        return [{"token_ids": row.tolist()} for row in out]
+        # trim each row at its first eos so the batched contract matches
+        # stream(): output ends AT the natural stop, no eos-padded tail
+        results = []
+        for row in out:
+            ids = row.tolist()
+            if self.eos_id in ids:
+                ids = ids[:ids.index(self.eos_id) + 1]
+            results.append({"token_ids": ids})
+        return results
 
     def stream(self, prompt: Sequence[int]):
         """Token-by-token generation: a generator the router streams back
@@ -148,6 +161,8 @@ class _LLMReplica:
                     self._next_rng(), last / max(self.temperature, 1e-6)
                 ).astype(jnp.int32)
             yield {"token_id": int(tok[0])}
+            if int(tok[0]) == self.eos_id:  # natural stop
+                return
             if i + 1 < self.max_new_tokens:  # last step has no consumer
                 last, cache = decode_step(self.params, cache, tok,
                                           self.cfg, start)
