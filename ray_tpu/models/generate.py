@@ -205,6 +205,9 @@ def generate(params: Params, prompt: jax.Array, cfg: TransformerConfig,
     if start is None:
         start = jnp.zeros((B,), jnp.int32)
     if max_new_tokens == 0:  # static arg: a free Python-level branch
+        if not cfg.causal:  # same contract as the nonzero path
+            raise ValueError("generation requires a causal (decoder) "
+                             "config; this config has causal=False")
         return prompt
     x, cache = _prefill_hidden(params, prompt, cfg, S, start)
     # only the LAST position's logits seed decoding: project [B,1,d]
