@@ -1,0 +1,240 @@
+"""LLM serving benchmark: continuous batching vs cohort batching.
+
+Measures the BASELINE.md north-star row 4 workload shape ("Serve
+Llama-3, continuous batching, RPS/p99") on the attached device with a
+closed-loop client pool issuing mixed-length generations, and writes
+`SERVE_BENCH_r4.json`:
+
+  - engine=continuous: `ray_tpu.models.engine.InferenceEngine` —
+    per-step slot admission/eviction (a finished sequence's slot is
+    refilled on the next decode step).
+  - engine=cohort: the round-3 `@serve.batch`-style path — requests
+    coalesce into a batch that runs `generate()` to the full
+    max_new_tokens, so every member pays for the longest.
+
+Both run the SAME model, client pool, and request distribution, so the
+continuous/cohort ratio isolates the scheduling policy. Reported per
+engine: requests/s, useful tokens/s, latency p50/p95/p99.
+
+Run: `python bench_serve.py [--model llama3-1b] [--duration 45]`.
+CPU fallback uses the tiny config (smoke numbers, not benchmarks).
+"""
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+
+def _build(model_name: str):
+    import jax
+
+    from ray_tpu.models.config import get_config, tiny_config
+    from ray_tpu.models.transformer import init_params
+    import jax.numpy as jnp
+
+    if jax.default_backend() == "cpu" and model_name != "tiny":
+        print("# cpu backend: falling back to tiny config", file=sys.stderr)
+        model_name = "tiny"
+    if model_name == "tiny":
+        cfg = tiny_config()
+    else:
+        cfg = get_config(model_name, param_dtype=jnp.bfloat16)
+    params = init_params(jax.random.key(0), cfg)
+    return model_name, cfg, params
+
+
+def _workload(rng_seed: int, max_prompt: int, max_new: int):
+    """Deterministic chat-shaped request stream: (prompt, max_new).
+
+    80% short answers (U[max/16, max/4]) and 20% long generations
+    (U[max/2, max]) — the high-variance mix continuous batching exists
+    for: a cohort pays max_new for every member, so the short majority
+    is held hostage by the long tail."""
+    import random
+
+    rng = random.Random(rng_seed)
+
+    def next_request():
+        plen = rng.randint(max(4, max_prompt // 8), max_prompt)
+        if rng.random() < 0.8:
+            want = rng.randint(max(2, max_new // 16), max(4, max_new // 4))
+        else:
+            want = rng.randint(max_new // 2, max_new)
+        return [rng.randint(1, 200) for _ in range(plen)], want
+    return next_request
+
+
+def _closed_loop(submit, *, clients: int, duration_s: float, seed: int,
+                 max_prompt: int, max_new: int):
+    """`clients` threads each submit-wait-repeat for `duration_s`;
+    returns (latencies, useful_tokens, n_done, wall)."""
+    latencies, tokens, lock = [], [0], threading.Lock()
+    stop = time.perf_counter() + duration_s
+
+    def client(cid: int):
+        nxt = _workload(seed + cid, max_prompt, max_new)
+        while time.perf_counter() < stop:
+            prompt, want = nxt()
+            t0 = time.perf_counter()
+            out = submit(prompt, want)
+            dt = time.perf_counter() - t0
+            with lock:
+                latencies.append(dt)
+                tokens[0] += len(out)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s * 4 + 120)
+    wall = time.perf_counter() - t0
+    return latencies, tokens[0], len(latencies), wall
+
+
+def _percentiles(lat):
+    lat = sorted(lat)
+
+    def pct(p):
+        if not lat:
+            return 0.0
+        return lat[min(len(lat) - 1, int(p / 100 * len(lat)))]
+    return {"p50_s": round(pct(50), 4), "p95_s": round(pct(95), 4),
+            "p99_s": round(pct(99), 4)}
+
+
+def bench_continuous(cfg, params, *, slots, max_prompt, max_new,
+                     clients, duration_s, decode_chunk=16,
+                     fetch_every=4):
+    from ray_tpu.models.engine import InferenceEngine
+
+    eng = InferenceEngine(params, cfg, slots=slots,
+                          max_prompt_len=max_prompt,
+                          max_new_tokens=max_new,
+                          decode_chunk=decode_chunk,
+                          fetch_every=fetch_every).serve_forever()
+    try:
+        # warm every compiled program (each prefill bucket + decode chunk)
+        for bucket in eng._buckets:
+            eng.generate(list(range(1, bucket + 1)), 2, timeout=1200)
+
+        def submit(prompt, want):
+            return eng.generate(prompt, want, timeout=600)
+
+        lat, toks, n, wall = _closed_loop(
+            submit, clients=clients, duration_s=duration_s, seed=17,
+            max_prompt=max_prompt, max_new=max_new)
+        return {"engine": "continuous", "requests": n,
+                "rps": round(n / wall, 2),
+                "useful_tokens_per_s": round(toks / wall, 1),
+                "decode_steps": eng.stats["decode_steps"],
+                **_percentiles(lat)}
+    finally:
+        eng.shutdown()
+
+
+def bench_cohort(cfg, params, *, slots, max_prompt, max_new,
+                 clients, duration_s):
+    """Round-3 cohort path: coalesce up to `slots` requests, run ONE
+    generate() to max_new for all, trim per request — the policy
+    continuous batching replaces."""
+    import numpy as np
+
+    import jax
+    from ray_tpu.models.generate import generate
+    from ray_tpu.serve.batching import _Batcher
+
+    batcher = _Batcher(slots, 0.005)
+
+    def run_batch(requests):
+        prompts = [p for (p, _w) in requests]
+        toks = np.zeros((slots, max_prompt), np.int32)
+        start = np.zeros(slots, np.int32)
+        for i, p in enumerate(prompts):
+            toks[i, max_prompt - len(p):] = p
+            start[i] = max_prompt - len(p)
+        out = generate(params, toks, cfg, max_new_tokens=max_new,
+                       greedy=True, rng=jax.random.key(0),
+                       start=start)
+        out = np.asarray(out)[:len(prompts), max_prompt:]
+        return [out[i, :w].tolist() for i, (_p, w) in enumerate(requests)]
+
+    # warm/compile the one batched program
+    run_batch([([1, 2, 3], 2)])
+
+    def submit(prompt, want):
+        return batcher.submit(run_batch, (prompt, want))
+
+    lat, toks, n, wall = _closed_loop(
+        submit, clients=clients, duration_s=duration_s, seed=17,
+        max_prompt=max_prompt, max_new=max_new)
+    return {"engine": "cohort", "requests": n, "rps": round(n / wall, 2),
+            "useful_tokens_per_s": round(toks / wall, 1),
+            **_percentiles(lat)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama3-1b")
+    ap.add_argument("--duration", type=float, default=45.0)
+    ap.add_argument("--clients", type=int, default=24)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--max-prompt", type=int, default=64)
+    ap.add_argument("--max-new", type=int, default=64)
+    ap.add_argument("--out", default="SERVE_BENCH_r4.json")
+    ap.add_argument("--decode-chunk", type=int, default=16)
+    ap.add_argument("--fetch-every", type=int, default=4)
+    ap.add_argument("--skip-cohort", action="store_true",
+                    help="iterate on the continuous engine only")
+    args = ap.parse_args()
+
+    import jax
+
+    model_name, cfg, params = _build(args.model)
+    if model_name == "tiny":
+        args.duration = min(args.duration, 10.0)
+
+    cont = bench_continuous(cfg, params, slots=args.slots,
+                            max_prompt=args.max_prompt,
+                            max_new=args.max_new, clients=args.clients,
+                            duration_s=args.duration,
+                            decode_chunk=args.decode_chunk,
+                            fetch_every=args.fetch_every)
+    print(json.dumps(cont), file=sys.stderr)
+    if args.skip_cohort:
+        print(json.dumps(cont))
+        return
+    coh = bench_cohort(cfg, params, slots=args.slots,
+                       max_prompt=args.max_prompt, max_new=args.max_new,
+                       clients=args.clients, duration_s=args.duration)
+    print(json.dumps(coh), file=sys.stderr)
+
+    result = {
+        "benchmark": "llm_serving_continuous_batching",
+        "model": model_name,
+        "backend": jax.default_backend(),
+        "slots": args.slots,
+        "clients": args.clients,
+        "max_prompt_len": args.max_prompt,
+        "max_new_tokens": args.max_new,
+        "duration_s": args.duration,
+        "request_distribution":
+            "prompt ~ U[max/8, max], new_tokens ~ U[max/8, max]",
+        "continuous": cont,
+        "cohort": coh,
+        "continuous_vs_cohort_tokens":
+            round(cont["useful_tokens_per_s"] /
+                  max(coh["useful_tokens_per_s"], 1e-9), 3),
+        "continuous_vs_cohort_p99":
+            round(coh["p99_s"] / max(cont["p99_s"], 1e-9), 3),
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -110,12 +110,16 @@ def rms_norm(x, gamma, eps):
 
 
 def _rope(x, positions, theta):
-    """Rotary embedding. x: [B, T, H, D]; positions: [T]."""
+    """Rotary embedding. x: [B, T, H, D]; positions: [T] (shared across
+    the batch) or [B, T] (per-row — continuous-batching decode, where
+    each cache slot sits at its own write position)."""
     d_half = x.shape[-1] // 2
     freqs = theta ** (-jnp.arange(0, d_half, dtype=jnp.float32) / d_half)
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T,Dh]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [...,T,Dh]
+    if angles.ndim == 2:
+        angles = angles[None]  # shared positions: broadcast over batch
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.astype(x.dtype)

@@ -178,6 +178,98 @@ class _LLMReplica:
         return self._batcher.submit(self._generate, prompt)
 
 
+class _ContinuousLLMReplica:
+    """Continuous-batching replica: slot-level admission/eviction.
+
+    Ref analog: the reference's request-cohort `@serve.batch`
+    (python/ray/serve/batching.py:337) holds a batch until every member
+    finishes decoding; this replica instead owns an
+    `ray_tpu.models.engine.InferenceEngine` whose decode loop refills a
+    finished sequence's slot on the very next step — one long generation
+    no longer stalls its batchmates (the vLLM-style redesign, TPU-first:
+    static slot shapes, one compiled decode program, on-device sampling).
+
+    ``tensor_parallel`` > 1 shards the model over that many local devices
+    (a `num_tpus=N`-class replica): params/cache carry tensor-axis
+    shardings and the SAME engine program runs TP via GSPMD propagation.
+    """
+
+    def __init__(self, model="tiny", *, slots: int = 8,
+                 max_prompt_len: int = 64, max_new_tokens: int = 32,
+                 checkpoint_dir: Optional[str] = None,
+                 greedy: bool = True, temperature: float = 1.0,
+                 pad_id: int = 0, eos_id: int = -1, seed: int = 0,
+                 tensor_parallel: int = 1, decode_chunk: int = 4,
+                 fetch_every: int = 1):
+        import jax
+
+        from ray_tpu.models.config import TransformerConfig, get_config
+        from ray_tpu.models.engine import InferenceEngine
+        from ray_tpu.models.transformer import init_params
+
+        cfg = (model if isinstance(model, TransformerConfig)
+               else get_config(model))
+        if checkpoint_dir is not None:
+            import pickle
+
+            with open(checkpoint_dir, "rb") as f:
+                params = jax.tree.map(np.asarray, pickle.load(f))
+        else:
+            params = init_params(jax.random.key(seed), cfg)
+        mesh = None
+        if tensor_parallel > 1:
+            from ray_tpu.parallel import MeshSpec
+
+            devices = jax.devices()
+            if len(devices) < tensor_parallel:
+                raise ValueError(
+                    f"tensor_parallel={tensor_parallel} but only "
+                    f"{len(devices)} local devices")
+            mesh = MeshSpec(data=1, fsdp=1, tensor=tensor_parallel) \
+                .build(devices[:tensor_parallel])
+        self.engine = InferenceEngine(
+            params, cfg, slots=slots, max_prompt_len=max_prompt_len,
+            max_new_tokens=max_new_tokens, greedy=greedy,
+            temperature=temperature, eos_id=eos_id, pad_id=pad_id,
+            mesh=mesh, seed=seed, decode_chunk=decode_chunk,
+            fetch_every=fetch_every).serve_forever()
+
+    def __call__(self, prompt: Sequence[int],
+                 max_new_tokens: Optional[int] = None) -> dict:
+        toks = self.engine.generate(prompt, max_new_tokens)
+        return {"token_ids": toks}
+
+    def stream(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None):
+        for tok in self.engine.submit_stream(prompt, max_new_tokens):
+            yield {"token_id": tok}
+
+    def engine_stats(self) -> dict:
+        return dict(self.engine.stats)
+
+    def __del__(self):
+        eng = getattr(self, "engine", None)
+        if eng is not None:
+            eng.shutdown()
+
+
+def build_continuous_llm_deployment(model="tiny", *, name: str = "llm",
+                                    num_replicas: int = 1,
+                                    max_concurrency: int = 32,
+                                    **replica_kwargs):
+    """-> an Application whose replicas continuously batch generations.
+
+    ``max_concurrency`` lifts the replica's query cap (and with it the
+    actor's thread cap) so many callers can block in ``__call__`` while
+    the engine thread interleaves them — admission happens per decode
+    step, not per cohort.
+    """
+    dep = deployment(_ContinuousLLMReplica, name=name) \
+        .options(num_replicas=num_replicas,
+                 max_concurrent_queries=max_concurrency)
+    return dep.bind(model, **replica_kwargs)
+
+
 def build_llm_deployment(model="tiny", *, name: str = "llm",
                          num_replicas: int = 1, **replica_kwargs):
     """-> an Application serving ``{prompt token ids} -> {token_ids}``.
