@@ -13,10 +13,15 @@ Algorithms are Tune Trainables, so ``Tuner(PPO, param_space=...)`` works.
 """
 
 from .algorithm import Algorithm, AlgorithmConfig
+from .appo import APPO, APPOConfig, APPOLearner
+from .bandits import BanditConfig, BanditLinTS, BanditLinUCB
 from .dqn import DQN, DQNConfig, DQNLearner
-from .env import (CartPole, Env, Pendulum, VectorEnv, make_env,
-                  register_env)
+from .env import (BreakoutMini, CartPole, ContextualBandit, Env, Pendulum,
+                  VectorEnv, make_env, register_env)
+from .es import ES, ESConfig, ESWorker
 from .impala import IMPALA, IMPALAConfig
+from .offline import (BC, CQL, BCConfig, CQLConfig, collect_dataset,
+                      load_batches, save_batches)
 from .learner import ImpalaLearner, LearnerGroup, PPOLearner, vtrace
 from .multi_agent import (MultiAgentBatch, MultiAgentEnv, MultiAgentPPO,
                           MultiAgentRolloutWorker)
@@ -38,4 +43,8 @@ __all__ = [
     "MultiAgentPPO", "MultiAgentRolloutWorker",
     "SAC", "SACConfig", "SACLearner", "Pendulum",
     "ContinuousRolloutWorker",
+    "APPO", "APPOConfig", "APPOLearner", "ES", "ESConfig", "ESWorker",
+    "BanditLinUCB", "BanditLinTS", "BanditConfig", "BC", "BCConfig",
+    "CQL", "CQLConfig", "collect_dataset", "load_batches", "save_batches",
+    "BreakoutMini", "ContextualBandit",
 ]

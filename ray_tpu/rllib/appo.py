@@ -1,0 +1,114 @@
+"""APPO: asynchronous PPO — IMPALA's pipelined sampling with the PPO
+clipped-surrogate loss over V-trace-corrected advantages.
+
+Ref analogs: rllib/algorithms/appo/appo.py (APPOConfig: use_kl_loss /
+clip_param on top of ImpalaConfig) and appo_torch_policy.py's loss:
+ratio = pi/behaviour, surrogate clipped at 1±clip, advantages and value
+targets from V-trace (asynchronous off-policy data). Re-design: same
+jitted-update shape as ImpalaLearner — the whole loss+Adam step is one
+XLA program; the async rollout pipeline is inherited from IMPALA.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import sample_batch as SB
+from .impala import IMPALA, IMPALAConfig
+from .learner import vtrace
+from .models import entropy_of, forward, init_actor_critic
+from .sample_batch import SampleBatch
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or APPO)
+        self.clip_param = 0.2
+        self.lr = 5e-4
+
+
+class APPOLearner:
+    """V-trace advantages + PPO ratio clip in one jitted update."""
+
+    def __init__(self, obs_dim: int, num_actions: int, *, lr: float = 5e-4,
+                 gamma: float = 0.99, clip_param: float = 0.2,
+                 vf_coeff: float = 0.5, entropy_coeff: float = 0.01,
+                 grad_clip: float = 40.0, clip_rho: float = 1.0,
+                 clip_c: float = 1.0, hiddens=(64, 64), seed: int = 0):
+        self.params = init_actor_critic(jax.random.key(seed), obs_dim,
+                                        num_actions, hiddens)
+        self.tx = optax.chain(optax.clip_by_global_norm(grad_clip),
+                              optax.adam(lr))
+        self.opt_state = self.tx.init(self.params)
+
+        def loss_fn(params, batch):
+            T, N = batch[SB.ACTIONS].shape
+            logits, values = forward(params,
+                                     batch[SB.OBS].reshape(T * N, -1))
+            logits = logits.reshape(T, N, -1)
+            values = values.reshape(T, N)
+            target_logp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits),
+                batch[SB.ACTIONS][..., None], axis=-1).squeeze(-1)
+            _, bootstrap_value = forward(params, batch["bootstrap_obs"])
+            vs, pg_adv = vtrace(
+                batch[SB.ACTION_LOGP], target_logp, batch[SB.REWARDS],
+                batch[SB.DONES], values, bootstrap_value, gamma,
+                clip_rho, clip_c)
+            adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+            ratio = jnp.exp(target_logp - batch[SB.ACTION_LOGP])
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv)
+            pi_loss = -surr.mean()
+            vf_loss = 0.5 * jnp.mean((values - vs) ** 2)
+            ent = entropy_of(logits.reshape(T * N, -1)).mean()
+            total = pi_loss + vf_coeff * vf_loss - entropy_coeff * ent
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": ent,
+                           "mean_ratio": jnp.mean(ratio)}
+
+        @jax.jit
+        def train_step(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        self._train_step = train_step
+
+    def update(self, batch: SampleBatch) -> dict:
+        self.params, self.opt_state, metrics = self._train_step(
+            self.params, self.opt_state,
+            {k: jnp.asarray(v) for k, v in batch.items()})
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in self.params.items()}
+
+    def set_weights(self, weights: Dict[str, np.ndarray]):
+        self.params = {k: jnp.asarray(v) for k, v in weights.items()}
+
+
+class APPO(IMPALA):
+    """IMPALA's async pipeline, APPO's clipped loss."""
+
+    _config_cls = APPOConfig
+
+    def _make_learner_factory(self, cfg, obs_dim, num_actions):
+        def make():
+            return APPOLearner(
+                obs_dim, num_actions, lr=cfg.lr, gamma=cfg.gamma,
+                clip_param=cfg.clip_param, vf_coeff=cfg.vf_coeff,
+                entropy_coeff=cfg.entropy_coeff, grad_clip=cfg.grad_clip,
+                clip_rho=cfg.clip_rho, clip_c=cfg.clip_c,
+                hiddens=cfg.model_hiddens, seed=cfg.seed)
+
+        return make

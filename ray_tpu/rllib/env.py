@@ -172,10 +172,141 @@ class StatelessGuess(Env):
         return self.reset(), r, True, {}
 
 
+class BreakoutMini(Env):
+    """MinAtar-style Breakout on a 10x10 grid (Atari-class benchmark env).
+
+    Ref analog: the reference's RLlib Atari suites (tuned_examples/*atari*)
+    run on ALE via gym; this image has neither, so the environment is a
+    from-scratch miniature in the spirit of MinAtar (Young & Tian 2019):
+    4 feature planes (2-wide paddle, ball, ball trail, bricks) on a
+    10x10 board, 3 actions (stay/left/right), +1 per brick, episode ends
+    when the ball falls past the paddle. Observation is the flattened
+    400-float board — enough spatial structure that linear policies
+    plateau, which is what a learner-throughput benchmark needs from
+    "Atari-class". (The paddle is 2 cells: brick bounces redirect the
+    ball unpredictably, and a 1-cell paddle at ball speed makes some
+    rallies geometrically unwinnable.)
+    """
+
+    N = 10
+    observation_dim = 4 * N * N
+    num_actions = 3
+    max_episode_steps = 1000
+
+    def __init__(self):
+        self._rng = np.random.default_rng(0)
+        self.reset()
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        n = self.N
+        self._paddle = n // 2
+        self._ball_x = int(self._rng.integers(0, n))
+        self._ball_y = 3
+        self._dx = 1 if self._rng.random() < 0.5 else -1
+        self._dy = 1
+        self._trail_x, self._trail_y = self._ball_x, self._ball_y
+        self._bricks = np.ones((3, n), np.bool_)
+        self._steps = 0
+        return self._obs()
+
+    def _obs(self) -> np.ndarray:
+        n = self.N
+        planes = np.zeros((4, n, n), np.float32)
+        planes[0, n - 1, self._paddle] = 1.0
+        planes[0, n - 1, min(self._paddle + 1, n - 1)] = 1.0
+        planes[1, self._ball_y, self._ball_x] = 1.0
+        planes[2, self._trail_y, self._trail_x] = 1.0
+        planes[3, :3, :] = self._bricks
+        return planes.reshape(-1)
+
+    def step(self, action: int):
+        n = self.N
+        if action == 1:
+            self._paddle = max(0, self._paddle - 1)
+        elif action == 2:
+            self._paddle = min(n - 2, self._paddle + 1)
+        self._trail_x, self._trail_y = self._ball_x, self._ball_y
+        nx = self._ball_x + self._dx
+        ny = self._ball_y + self._dy
+        if nx < 0 or nx >= n:  # side wall
+            self._dx = -self._dx
+            nx = self._ball_x + self._dx
+        reward = 0.0
+        if ny < 0:  # ceiling
+            self._dy = 1
+            ny = self._ball_y + self._dy
+        if ny < 3 and self._bricks[ny, nx]:  # brick hit
+            self._bricks[ny, nx] = False
+            reward = 1.0
+            self._dy = -self._dy
+            ny = self._ball_y + self._dy
+        done = False
+        if ny == n - 1:  # paddle row (paddle covers 2 cells)
+            if nx in (self._paddle, self._paddle + 1):
+                self._dy = -1
+                ny = self._ball_y + self._dy
+            else:
+                done = True  # ball lost
+        if not self._bricks.any():  # cleared: fresh wall, keep going
+            self._bricks[:] = True
+        self._ball_x, self._ball_y = nx, ny
+        self._steps += 1
+        timeout = self._steps >= self.max_episode_steps
+        info = {"truncated": True} if (timeout and not done) else {}
+        return self._obs(), reward, done or timeout, info
+
+
+class ContextualBandit(Env):
+    """Linear contextual bandit: one-step episodes, K arms whose expected
+    reward is a fixed hidden linear function of the context.
+
+    Ref analog: rllib/env/wrappers + the bandit envs under
+    rllib/examples/env/bandit_envs_discrete.py — redesigned minimal: the
+    env owns hidden arm vectors theta_k; reward = theta_k . x + noise;
+    ``best_mean`` is exposed so tests measure regret exactly.
+    """
+
+    CONTEXT_DIM = 8
+    NUM_ARMS = 5
+    observation_dim = CONTEXT_DIM
+    num_actions = NUM_ARMS
+    max_episode_steps = 1
+
+    def __init__(self):
+        self._rng = np.random.default_rng(0)
+        theta_rng = np.random.default_rng(1234)  # fixed task
+        self.theta = theta_rng.normal(
+            size=(self.NUM_ARMS, self.CONTEXT_DIM)).astype(np.float32)
+        self.theta /= np.linalg.norm(self.theta, axis=1, keepdims=True)
+        self.noise = 0.1
+        self._ctx = np.zeros(self.CONTEXT_DIM, np.float32)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._ctx = self._rng.normal(
+            size=self.CONTEXT_DIM).astype(np.float32)
+        self._ctx /= max(np.linalg.norm(self._ctx), 1e-8)
+        return self._ctx.copy()
+
+    def means(self) -> np.ndarray:
+        return self.theta @ self._ctx
+
+    def step(self, action: int):
+        means = self.means()
+        r = float(means[action] + self._rng.normal() * self.noise)
+        info = {"regret": float(means.max() - means[action])}
+        return self.reset(), r, True, info
+
+
 _REGISTRY: Dict[str, Callable[[], Env]] = {
     "CartPole-v1": CartPole,
     "Pendulum-v1": Pendulum,
     "StatelessGuess-v0": StatelessGuess,
+    "Breakout-Mini-v0": BreakoutMini,
+    "ContextualBandit-v0": ContextualBandit,
 }
 
 

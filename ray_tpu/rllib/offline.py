@@ -1,0 +1,316 @@
+"""Offline RL: logged-experience datasets, behavior cloning, discrete CQL.
+
+Ref analogs: rllib/offline/ (JsonWriter/JsonReader over logged
+SampleBatches, `input_="dataset"` configs) and the offline algorithms
+(rllib/algorithms/bc, rllib/algorithms/cql). Re-design: datasets are
+.npz shards of column arrays (numpy-native, zero-copy into jnp); both
+learners are single jitted XLA updates; evaluation runs the greedy
+policy in a fresh env on the driver (no rollout fleet — offline
+algorithms never sample).
+
+CQL here is the discrete-action form: the DQN double-Q TD loss plus the
+conservative penalty alpha * E[logsumexp_a Q(s,a) - Q(s, a_data)]
+(Kumar et al. 2020, eq. 4 with the sampled-action term collapsed to the
+closed discrete form).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import sample_batch as SB
+from .algorithm import Algorithm, AlgorithmConfig
+from .env import VectorEnv, make_env
+from .models import entropy_of, forward, init_actor_critic, logp_of
+from .sample_batch import SampleBatch, concat_samples
+
+# ---------------------------------------------------------------- dataset IO
+
+
+def save_batches(path: str, batches: List[SampleBatch]) -> List[str]:
+    """Write SampleBatches as .npz shards under ``path``; returns files.
+
+    Ref analog: rllib/offline/json_writer.py (one file per batch; columns
+    keyed exactly as SampleBatch keys)."""
+    os.makedirs(path, exist_ok=True)
+    files = []
+    for i, b in enumerate(batches):
+        f = os.path.join(path, f"batch-{i:05d}.npz")
+        np.savez_compressed(f, **{k: np.asarray(v) for k, v in b.items()})
+        files.append(f)
+    return files
+
+
+def load_batches(path: str) -> SampleBatch:
+    """Read every shard under ``path`` into one concatenated SampleBatch
+    (ref: rllib/offline/json_reader.py)."""
+    files = sorted(glob.glob(os.path.join(path, "*.npz")))
+    if not files:
+        raise FileNotFoundError(f"no .npz shards under {path}")
+    batches = []
+    for f in files:
+        with np.load(f) as z:
+            batches.append(SampleBatch({k: z[k] for k in z.files}))
+    return concat_samples(batches)
+
+
+def collect_dataset(env_name, path: str, *, num_steps: int = 4096,
+                    num_envs: int = 8, epsilon: float = 0.3,
+                    weights: Optional[Dict[str, np.ndarray]] = None,
+                    hiddens=(64, 64), seed: int = 0) -> List[str]:
+    """Roll an epsilon-greedy behavior policy and log (s, a, r, s', done)
+    shards — the offline-RL data-generation step (ref: the reference's
+    `rllib train ... --output` logged-experience path)."""
+    vec = VectorEnv(env_name, num_envs, seed=seed)
+    params = weights or {
+        k: np.asarray(v) for k, v in init_actor_critic(
+            jax.random.key(seed), vec.observation_dim, vec.num_actions,
+            hiddens).items()}
+    rng = np.random.default_rng(seed)
+    T = num_steps // num_envs
+    obs_buf = np.zeros((T, num_envs, vec.observation_dim), np.float32)
+    act_buf = np.zeros((T, num_envs), np.int64)
+    rew_buf = np.zeros((T, num_envs), np.float32)
+    done_buf = np.zeros((T, num_envs), np.bool_)
+    next_buf = np.zeros((T, num_envs, vec.observation_dim), np.float32)
+    obs = vec.obs
+    for t in range(T):
+        logits, _ = forward(params, jnp.asarray(obs))
+        acts = np.asarray(jnp.argmax(logits, axis=-1))
+        explore = rng.random(num_envs) < epsilon
+        acts = np.where(explore,
+                        rng.integers(0, vec.num_actions, num_envs), acts)
+        obs_buf[t] = obs
+        act_buf[t] = acts
+        obs, rews, dones = vec.step(acts)
+        next_buf[t] = vec.final_obs
+        rew_buf[t] = rews
+        done_buf[t] = dones & ~vec.truncateds
+    flat = lambda x: x.reshape((T * num_envs,) + x.shape[2:])  # noqa: E731
+    batch = SampleBatch({SB.OBS: flat(obs_buf), SB.ACTIONS: flat(act_buf),
+                         SB.REWARDS: flat(rew_buf),
+                         SB.DONES: flat(done_buf),
+                         SB.NEXT_OBS: flat(next_buf)})
+    return save_batches(path, [batch])
+
+
+# ------------------------------------------------------------- algorithms
+
+
+class OfflineConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class)
+        self.input_path = ""          # directory of .npz shards
+        self.train_batch_size = 256
+        self.num_updates_per_iter = 64
+        self.evaluation_episodes = 3
+
+
+class _OfflineAlgorithm(Algorithm):
+    """Shared shape: load the dataset once, minibatch-update per step,
+    evaluate greedily in a fresh env."""
+
+    _config_cls = OfflineConfig
+
+    def setup(self, config):
+        cfg = config.get("__algo_config__")
+        cfg = cfg.copy() if cfg is not None else self.get_default_config()
+        cfg.update_from_dict(
+            {k: v for k, v in config.items() if k != "__algo_config__"})
+        self.algo_config = cfg
+        if not cfg.input_path:
+            raise ValueError(
+                "offline algorithms need config.offline_data(input_path=...)")
+        self.dataset = load_batches(cfg.input_path)
+        probe = make_env(cfg.env)
+        self._obs_dim = probe.observation_dim
+        self._num_actions = probe.num_actions
+        self._rng = np.random.default_rng(cfg.seed)
+        self._num_env_steps = 0  # offline: no env interaction
+        self._make_learner(cfg)
+
+    def _make_learner(self, cfg):
+        raise NotImplementedError
+
+    def _minibatch(self) -> SampleBatch:
+        n = self.dataset.count
+        idx = self._rng.integers(0, n, self.algo_config.train_batch_size)
+        return SampleBatch({k: v[idx] for k, v in self.dataset.items()})
+
+    def evaluate_policy(self) -> float:
+        env = make_env(self.algo_config.env)
+        rets = []
+        w = self.get_policy_weights()
+        for ep in range(self.algo_config.evaluation_episodes):
+            obs = env.reset(seed=40_000 + self.iteration * 10 + ep)
+            total, done = 0.0, False
+            while not done:
+                logits, _ = forward(w, jnp.asarray(obs[None]))
+                obs, r, done, _ = env.step(int(jnp.argmax(logits[0])))
+                total += r
+            rets.append(total)
+        return float(np.mean(rets))
+
+    def step(self) -> dict:
+        metrics = self.training_step()
+        metrics["episode_reward_mean"] = self.evaluate_policy()
+        metrics["dataset_size"] = self.dataset.count
+        return metrics
+
+    def cleanup(self):
+        pass
+
+
+class BCConfig(OfflineConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or BC)
+
+    def offline_data(self, *, input_path: str) -> "BCConfig":
+        self.input_path = input_path
+        return self
+
+
+class BC(_OfflineAlgorithm):
+    """Behavior cloning: maximize log pi(a_data | s) (ref:
+    rllib/algorithms/bc/bc.py — MARWIL with beta=0)."""
+
+    _config_cls = BCConfig
+
+    def _make_learner(self, cfg):
+        self.params = init_actor_critic(
+            jax.random.key(cfg.seed), self._obs_dim, self._num_actions,
+            cfg.model_hiddens)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        ent_coeff = cfg.entropy_coeff
+
+        def loss_fn(params, batch):
+            logits, _ = forward(params, batch[SB.OBS])
+            logp = logp_of(logits, batch[SB.ACTIONS])
+            ent = entropy_of(logits).mean()
+            loss = -logp.mean() - ent_coeff * ent
+            return loss, {"bc_logp": logp.mean(), "entropy": ent}
+
+        @jax.jit
+        def train_step(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        self._train_step = train_step
+
+    def training_step(self) -> dict:
+        metrics = {}
+        for _ in range(self.algo_config.num_updates_per_iter):
+            mb = self._minibatch()
+            self.params, self.opt_state, metrics = self._train_step(
+                self.params, self.opt_state,
+                {SB.OBS: jnp.asarray(mb[SB.OBS]),
+                 SB.ACTIONS: jnp.asarray(mb[SB.ACTIONS])})
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_policy_weights(self):
+        return {k: np.asarray(v) for k, v in self.params.items()}
+
+    def save_checkpoint(self):
+        return {"weights": self.get_policy_weights()}
+
+    def load_checkpoint(self, checkpoint):
+        if checkpoint:
+            self.params = {k: jnp.asarray(v)
+                           for k, v in checkpoint["weights"].items()}
+
+
+class CQLConfig(OfflineConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or CQL)
+        self.cql_alpha = 1.0
+        self.target_update_every = 8  # learner updates between target syncs
+        self.lr = 3e-4
+
+    def offline_data(self, *, input_path: str) -> "CQLConfig":
+        self.input_path = input_path
+        return self
+
+
+class CQL(_OfflineAlgorithm):
+    """Discrete conservative Q-learning: double-DQN TD loss on logged
+    transitions + alpha * (logsumexp_a Q - Q(s, a_data))."""
+
+    _config_cls = CQLConfig
+
+    def _make_learner(self, cfg):
+        self.params = init_actor_critic(
+            jax.random.key(cfg.seed), self._obs_dim, self._num_actions,
+            cfg.model_hiddens)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        gamma, alpha = cfg.gamma, cfg.cql_alpha
+
+        def loss_fn(params, target_params, batch):
+            q_all, _ = forward(params, batch[SB.OBS])
+            q_data = jnp.take_along_axis(
+                q_all, batch[SB.ACTIONS][:, None], axis=1).squeeze(-1)
+            q_next_t, _ = forward(target_params, batch[SB.NEXT_OBS])
+            q_next_o, _ = forward(params, batch[SB.NEXT_OBS])
+            a_star = jnp.argmax(q_next_o, axis=1)
+            q_next = jnp.take_along_axis(
+                q_next_t, a_star[:, None], axis=1).squeeze(-1)
+            not_done = 1.0 - batch[SB.DONES].astype(jnp.float32)
+            target = batch[SB.REWARDS] + gamma * not_done * q_next
+            td = optax.huber_loss(
+                q_data, jax.lax.stop_gradient(target), delta=1.0).mean()
+            # conservative penalty: push down unseen actions' Q
+            cql = (jax.nn.logsumexp(q_all, axis=1) - q_data).mean()
+            loss = td + alpha * cql
+            return loss, {"td_loss": td, "cql_penalty": cql,
+                          "q_data_mean": q_data.mean()}
+
+        @jax.jit
+        def train_step(params, target_params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        self._train_step = train_step
+        self._updates = 0
+
+    def training_step(self) -> dict:
+        metrics = {}
+        for _ in range(self.algo_config.num_updates_per_iter):
+            mb = self._minibatch()
+            self.params, self.opt_state, metrics = self._train_step(
+                self.params, self.target_params, self.opt_state,
+                {k: jnp.asarray(v) for k, v in mb.items()
+                 if k in (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.DONES,
+                          SB.NEXT_OBS)})
+            self._updates += 1
+            if self._updates % self.algo_config.target_update_every == 0:
+                self.target_params = jax.tree.map(jnp.copy, self.params)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_policy_weights(self):
+        return {k: np.asarray(v) for k, v in self.params.items()}
+
+    def save_checkpoint(self):
+        return {"weights": self.get_policy_weights()}
+
+    def load_checkpoint(self, checkpoint):
+        if checkpoint:
+            self.params = {k: jnp.asarray(v)
+                           for k, v in checkpoint["weights"].items()}
+            self.target_params = jax.tree.map(jnp.copy, self.params)
