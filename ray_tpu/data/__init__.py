@@ -24,10 +24,13 @@ from .read_api import (
     read_binary_files,
     read_csv,
     read_datasource,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
     read_text,
+    read_tfrecords,
+    read_webdataset,
 )
 from .read_api import from_numpy_arrays as from_numpy
 from .read_api import from_pandas_df as from_pandas
@@ -38,5 +41,6 @@ __all__ = [
     "range", "range_tensor", "from_items", "from_pandas", "from_pandas_df",
     "from_numpy", "from_numpy_arrays", "from_arrow", "from_blocks",
     "read_parquet", "read_csv", "read_json", "read_numpy", "read_text",
-    "read_binary_files", "read_datasource",
+    "read_binary_files", "read_datasource", "read_images",
+    "read_tfrecords", "read_webdataset",
 ]

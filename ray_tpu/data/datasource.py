@@ -65,13 +65,19 @@ class ItemsDatasource(Datasource):
         return tasks or [lambda: build_block([])]
 
 
-def _expand_paths(paths: Union[str, List[str]], suffix: str) -> List[str]:
+def _expand_paths(paths: Union[str, List[str]], suffix) -> List[str]:
+    """``suffix`` may be one extension or a tuple of alternatives
+    (image datasources match several)."""
+    suffixes = (suffix,) if isinstance(suffix, str) else tuple(suffix)
     if isinstance(paths, str):
         paths = [paths]
     out: List[str] = []
     for p in paths:
         if os.path.isdir(p):
-            out.extend(sorted(_glob.glob(os.path.join(p, f"*{suffix}"))))
+            hits: List[str] = []
+            for sfx in suffixes:
+                hits.extend(_glob.glob(os.path.join(p, f"*{sfx}")))
+            out.extend(sorted(set(hits)))
         elif any(ch in p for ch in "*?["):
             out.extend(sorted(_glob.glob(p)))
         else:
@@ -154,6 +160,92 @@ class TextDatasource(FileDatasource):
     def read_file(self, path: str) -> Block:
         with open(path) as f:
             return build_block([{"text": line.rstrip("\n")} for line in f])
+
+
+class ImageDatasource(FileDatasource):
+    """Image files -> rows {"image": HxWxC uint8, "path"} (ref:
+    python/ray/data/datasource/image_datasource.py — same size/mode
+    options and extension filter; decoding via PIL)."""
+
+    suffix = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+    def read_file(self, path: str) -> Block:
+        from PIL import Image
+
+        img = Image.open(path)
+        mode = self.options.get("mode")
+        if mode:
+            img = img.convert(mode)
+        size = self.options.get("size")
+        if size:
+            img = img.resize((size[1], size[0]))  # PIL wants (W, H)
+        arr = np.asarray(img)
+        return build_block([{"image": arr, "path": path}])
+
+
+class TFRecordDatasource(FileDatasource):
+    """TFRecord files of tf.train.Example -> one column per feature
+    (ref: tfrecords_datasource.py; no-TF codec in data/tfrecord.py).
+    Scalar-per-row features are unwrapped from their length-1 lists."""
+
+    suffix = ".tfrecords"
+
+    def read_file(self, path: str) -> Block:
+        from .tfrecord import decode_example, read_records
+
+        rows = []
+        for payload in read_records(path):
+            ex = decode_example(payload)
+            row = {}
+            for name, vals in ex.items():
+                row[name] = vals[0] if len(vals) == 1 else list(vals)
+            rows.append(row)
+        return build_block(rows)
+
+
+class WebDatasetDatasource(FileDatasource):
+    """.tar shards of basename-grouped samples (webdataset layout:
+    `key.jpg`, `key.cls`, `key.json` -> one row per key with a column
+    per extension). Ref: python/ray/data/datasource/webdataset_datasource
+    .py — same grouping rule, stdlib tarfile instead of the wds library.
+    """
+
+    suffix = ".tar"
+
+    _DECODERS = {
+        "cls": lambda b: int(b.decode()),
+        "txt": lambda b: b.decode(),
+        "json": lambda b: __import__("json").loads(b.decode()),
+    }
+
+    def read_file(self, path: str) -> Block:
+        import tarfile
+
+        samples: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        with tarfile.open(path) as tar:
+            for member in tar:
+                if not member.isfile():
+                    continue
+                base = os.path.basename(member.name)
+                key, _, ext = base.partition(".")
+                raw = tar.extractfile(member).read()
+                dec = self._DECODERS.get(ext)
+                if dec is not None:
+                    value: Any = dec(raw)
+                elif ext in ("jpg", "jpeg", "png"):
+                    import io
+
+                    from PIL import Image
+
+                    value = np.asarray(Image.open(io.BytesIO(raw)))
+                else:
+                    value = raw
+                if key not in samples:
+                    samples[key] = {"__key__": key}
+                    order.append(key)
+                samples[key][ext] = value
+        return build_block([samples[k] for k in order])
 
 
 # ------------------------------------------------------------------ writers

@@ -97,6 +97,31 @@ def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
     return _read(BinaryDatasource(paths), parallelism)
 
 
+def read_images(paths, *, size=None, mode=None,
+                parallelism: int = -1) -> Dataset:
+    """Image files -> rows {"image": HxWxC uint8, "path"} (ref:
+    read_api.read_images; size=(H, W) resizes, mode converts e.g. RGB)."""
+    from .datasource import ImageDatasource
+
+    return _read(ImageDatasource(paths, size=size, mode=mode), parallelism)
+
+
+def read_tfrecords(paths, *, parallelism: int = -1) -> Dataset:
+    """tf.train.Example TFRecords -> one column per feature (ref:
+    read_api.read_tfrecords; no-TF codec)."""
+    from .datasource import TFRecordDatasource
+
+    return _read(TFRecordDatasource(paths), parallelism)
+
+
+def read_webdataset(paths, *, parallelism: int = -1) -> Dataset:
+    """WebDataset .tar shards -> one row per sample key (ref:
+    read_api.read_webdataset)."""
+    from .datasource import WebDatasetDatasource
+
+    return _read(WebDatasetDatasource(paths), parallelism)
+
+
 def read_datasource(datasource: Datasource, *, parallelism: int = -1
                     ) -> Dataset:
     return _read(datasource, parallelism)
