@@ -17,6 +17,12 @@ def main():
     node_idx = int(os.environ["RAY_TPU_NODE_IDX"])
     worker_id = os.environ["RAY_TPU_WORKER_ID"]
 
+    from ray_tpu.profiling import install_profile_handler
+
+    # SIGUSR1 -> on-demand stack sampling (ref analog: the dashboard's
+    # py-spy-on-PID profiling; profiling.py)
+    install_profile_handler(session_dir, worker_id)
+
     from .context import CoreContext, set_context
 
     ctx = CoreContext(head_addr=head_addr, session_dir=session_dir,

@@ -11,8 +11,14 @@ agent processes. Endpoints:
     /api/cluster            -> resource totals/availability
     /api/jobs               -> submitted jobs (jobs.py)
     /api/metrics            -> merged metric rows (JSON)
+    /api/summary/{tasks,actors,objects} -> state summaries
+    /api/timeline           -> chrome-trace events (tracing.timeline)
+    /api/serve/applications -> serve deployment status rows
     /metrics                -> Prometheus text exposition
-    /                       -> auto-refreshing HTML overview
+    /                       -> the SPA (dashboard_ui.py; hash-routed
+                               nodes/actors/tasks/jobs/metrics/serve/
+                               timeline pages, the reference's React
+                               client re-done as one vanilla-JS file)
 """
 
 from __future__ import annotations
@@ -23,35 +29,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import ray_tpu
-
-_INDEX_HTML = """<!doctype html>
-<html><head><title>ray_tpu dashboard</title>
-<style>body{font-family:monospace;margin:2em}table{border-collapse:collapse}
-td,th{border:1px solid #999;padding:4px 8px;text-align:left}</style></head>
-<body><h2>ray_tpu cluster</h2><div id=cluster></div>
-<h3>nodes</h3><table id=nodes></table>
-<h3>actors</h3><table id=actors></table>
-<h3>recent tasks</h3><table id=tasks></table>
-<script>
-async function fill(id, url, cols) {
-  const rows = await (await fetch(url)).json();
-  const t = document.getElementById(id);
-  t.innerHTML = '<tr>' + cols.map(c => '<th>'+c+'</th>').join('') + '</tr>' +
-    rows.slice(0, 50).map(r => '<tr>' + cols.map(
-      c => '<td>' + JSON.stringify(r[c] ?? '') + '</td>').join('') +
-      '</tr>').join('');
-}
-async function refresh() {
-  const c = await (await fetch('/api/cluster')).json();
-  document.getElementById('cluster').textContent = JSON.stringify(c);
-  await fill('nodes', '/api/nodes',
-             ['node_idx','alive','resources_total','resources_available']);
-  await fill('actors', '/api/actors',
-             ['actor_id','class_name','name','state']);
-  await fill('tasks', '/api/tasks', ['task_id','name','state','node_idx']);
-}
-refresh(); setInterval(refresh, 2000);
-</script></body></html>"""
+from ray_tpu.dashboard_ui import INDEX_HTML as _INDEX_HTML
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -72,9 +50,13 @@ class _Handler(BaseHTTPRequestHandler):
                    "application/json")
 
     def do_GET(self):  # noqa: N802 - stdlib API
+        from urllib.parse import parse_qs, urlsplit
+
         from ray_tpu import metrics, state
 
-        path = self.path.split("?")[0].rstrip("/") or "/"
+        split = urlsplit(self.path)
+        query = {k: v[-1] for k, v in parse_qs(split.query).items()}
+        path = split.path.rstrip("/") or "/"
         try:
             if path == "/":
                 self._send(200, _INDEX_HTML.encode(), "text/html")
@@ -97,6 +79,49 @@ class _Handler(BaseHTTPRequestHandler):
                     self._json(ray_tpu.get(mgr.list.remote(), timeout=10))
             elif path == "/api/metrics":
                 self._json(metrics.metrics_summary())
+            elif path == "/api/timeline":
+                from ray_tpu import tracing
+
+                self._json(tracing.timeline())
+            elif path == "/api/profile":
+                # on-demand flamegraph: ?worker_id=...&duration_s=1&hz=100
+                # (omit worker_id to profile the driver/head process);
+                # ref analog: dashboard/modules/reporter/profile_manager
+                from ray_tpu import profiling
+
+                dur = float(query.get("duration_s", 1.0))
+                hz = float(query.get("hz", 100.0))
+                wid = query.get("worker_id")
+                if wid:
+                    self._json(profiling.profile_worker(
+                        wid, duration_s=dur, hz=hz))
+                else:
+                    self._json(profiling.profile_self(
+                        duration_s=dur, hz=hz))
+            elif path.startswith("/api/summary/"):
+                kind = path[len("/api/summary/"):]
+                fn = {"tasks": state.summarize_tasks,
+                      "actors": state.summarize_actors,
+                      "objects": state.summarize_objects}.get(kind)
+                if fn is None:
+                    self._json({"error": f"unknown summary {kind}"}, 404)
+                else:
+                    self._json(fn())
+            elif path == "/api/serve/applications":
+                from ray_tpu import serve
+
+                rows = []
+                for app, info in serve.status()["applications"].items():
+                    for dn, dep in info.get("deployments", {}).items():
+                        running = dep.get("replica_states", {}) \
+                            .get("RUNNING", 0)
+                        rows.append({
+                            "app": app, "deployment": dn,
+                            "target_replicas": dep.get("target_replicas"),
+                            "running_replicas": running,
+                            "version": dep.get("version"),
+                            "status": dep.get("status")})
+                self._json(rows)
             elif path == "/metrics":
                 self._send(200, metrics.export_prometheus().encode(),
                            "text/plain; version=0.0.4")

@@ -128,6 +128,45 @@ def cmd_status(args):
     return 0
 
 
+def cmd_profile(args):
+    """Flamegraph a live worker (ref analog: the dashboard's
+    py-spy-on-PID endpoint, reporter/profile_manager.py)."""
+    import sys
+
+    from ray_tpu import profiling
+    from ray_tpu import state as state_api
+    from ray_tpu.core.context import get_context
+
+    _attached(args)
+    if args.worker_id == "driver":
+        result = profiling.profile_self(duration_s=args.duration,
+                                        hz=args.hz)
+    else:
+        rows = [w for w in state_api.list_workers(limit=10_000)
+                if w.get("worker_id") == args.worker_id
+                and w.get("state") != "dead"]
+        if not rows:
+            print(f"no live worker {args.worker_id!r}", file=sys.stderr)
+            return 1
+        remote_idxs = {n["node_idx"] for n in state_api.list_nodes()
+                       if n.get("is_remote")}
+        if rows[0].get("node_idx") in remote_idxs:
+            # the pid belongs to ANOTHER host — signaling it here would
+            # hit an unrelated local process
+            print(f"worker {args.worker_id!r} runs on a remote node; "
+                  f"run the profile from that host", file=sys.stderr)
+            return 1
+        session_dir = get_context().session_dir
+        result = profiling.profile_pid(
+            session_dir, args.worker_id, rows[0]["pid"],
+            duration_s=args.duration, hz=args.hz)
+    print(f"# {result['samples']} samples over {args.duration}s "
+          f"(pid {result['pid']}); paste into flamegraph.pl/speedscope",
+          file=sys.stderr)
+    print(result["folded"])
+    return 0
+
+
 def cmd_list(args):
     from ray_tpu import state as state_api
 
@@ -193,6 +232,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("entity", choices=["tasks", "actors", "objects"])
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser(
+        "profile",
+        help="flamegraph a live worker (folded stacks to stdout)")
+    sp.add_argument("worker_id", help="worker id from `list workers`, or "
+                                      "'driver' for the head process")
+    sp.add_argument("--duration", type=float, default=1.0)
+    sp.add_argument("--hz", type=float, default=100.0)
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_profile)
 
     # ----- serve group (ref: the `serve` CLI, python/ray/serve/scripts.py)
     sp = sub.add_parser("serve", help="model-serving commands")

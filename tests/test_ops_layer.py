@@ -172,6 +172,126 @@ def test_job_can_attach_to_cluster(ray_start):
     assert "cpus: 4.0" in out
 
 
+class RecordingCloud:
+    """CloudAPI stub recording scale requests (no processes)."""
+
+    num_cpus = 2
+
+    def __init__(self):
+        self.nodes = []
+        self.requests = []
+        self._next = 0
+
+    def list_nodes(self):
+        return list(self.nodes)
+
+    def submit_scale_request(self, req):
+        self.requests.append(req)
+        for pid in req.workers_to_delete:
+            if pid in self.nodes:
+                self.nodes.remove(pid)
+        while len(self.nodes) > req.desired_num_workers:
+            self.nodes.pop()
+        while len(self.nodes) < req.desired_num_workers:
+            self.nodes.append(f"cloud-{self._next}")
+            self._next += 1
+
+
+def test_batching_provider_coalesces_one_scale_request():
+    """N create_node calls in one update -> ONE declarative resize (ref:
+    batching_node_provider.py:63 post_process submits once)."""
+    from ray_tpu.autoscaler import Autoscaler, AutoscalingPolicy
+    from ray_tpu.autoscaler import BatchingNodeProvider
+
+    cloud = RecordingCloud()
+    provider = BatchingNodeProvider(cloud)
+    head = FakeHead()
+    sc = Autoscaler(head, provider, AutoscalingPolicy(
+        max_workers=8, max_launch_batch=4))
+    head._pending_leases = [1] * 8  # 8 leases, 2 cpus/node -> want 4
+    sc.update()
+    assert len(cloud.requests) == 1, "creates must coalesce"
+    assert cloud.requests[0].desired_num_workers == 4
+    assert cloud.list_nodes() == ["cloud-0", "cloud-1", "cloud-2",
+                                  "cloud-3"]
+    # nothing changed -> no new request
+    head._pending_leases = []
+    sc._tracked.clear()  # (no head registration in this unit test)
+    sc.update()
+    assert len(cloud.requests) == 1
+
+
+def test_batching_provider_delete_names_specific_workers():
+    from ray_tpu.autoscaler import BatchingNodeProvider
+
+    cloud = RecordingCloud()
+    cloud.nodes = ["cloud-0", "cloud-1", "cloud-2"]
+    provider = BatchingNodeProvider(cloud)
+    assert provider.non_terminated_nodes() == cloud.nodes
+    provider.terminate_node("cloud-1")
+    provider.post_process()
+    req = cloud.requests[-1]
+    assert req.workers_to_delete == ["cloud-1"]
+    assert req.desired_num_workers == 2
+    assert "cloud-1" not in cloud.list_nodes()
+
+
+def test_fake_gke_tpu_pool_scales_up_and_down():
+    """E2E: demand scales a fake GKE TPU node pool up (real node agents
+    joining over TCP with TPU resources + accelerator label), idleness
+    scales it back down (ref: GCPTPU + batching provider + the
+    reference's fake-multinode autoscaler e2e)."""
+    from ray_tpu.autoscaler import (Autoscaler, AutoscalingPolicy,
+                                    BatchingNodeProvider, FakeGkeTpuCloud)
+
+    info = ray_tpu.init(num_cpus=1, num_tpus=0, _system_config={
+        "idle_worker_keep_alive_s": 1.0})
+    cloud = None
+    try:
+        head = info.head
+        addr = head.enable_tcp(host="127.0.0.1", advertise_ip="127.0.0.1")
+        cloud = FakeGkeTpuCloud(addr, num_tpus_per_node=4,
+                                num_cpus_per_node=1,
+                                provision_delay_s=0.2)
+        sc = Autoscaler(head, BatchingNodeProvider(cloud),
+                        AutoscalingPolicy(max_workers=1,
+                                          idle_timeout_s=1.5,
+                                          update_interval_s=0.2))
+        sc.start()
+        try:
+            @ray_tpu.remote(num_tpus=4)
+            def on_tpu_pool():
+                import os
+
+                return os.environ.get("TPU_VISIBLE_CHIPS", "")
+
+            # the head node has no TPUs: the lease queues, the pool grows
+            ref = on_tpu_pool.remote()
+            chips = ray_tpu.get(ref, timeout=90)
+            assert chips != ""  # 4 chips were assigned on the pool node
+            nodes = ray_tpu.nodes()
+            pool = [n for n in nodes
+                    if n["labels"].get("accelerator") == "tpu-v5e-4"]
+            assert len(pool) == 1
+            assert pool[0]["resources_total"].get("TPU") == 4.0
+            # idle past the timeout -> ONE shrink request, pool empties
+            deadline = time.monotonic() + 40
+            while time.monotonic() < deadline:
+                if len(cloud.list_nodes()) == 0:
+                    break
+                time.sleep(0.3)
+            assert cloud.list_nodes() == [], "idle pool not scaled down"
+            shrink = [r for r in cloud.scale_requests
+                      if r.workers_to_delete]
+            assert shrink, "scale-down must name the drained worker"
+        finally:
+            sc.stop()
+    finally:
+        if cloud is not None:
+            cloud.shutdown()
+        ray_tpu.shutdown()
+
+
 def test_dashboard_endpoints(ray_start):
     from ray_tpu.dashboard import start_dashboard
 
@@ -203,5 +323,60 @@ def test_dashboard_endpoints(ray_start):
         assert status == 200
         status, body = fetch("/api/bogus")
         assert status == 404
+    finally:
+        dash.stop()
+
+
+def test_dashboard_spa_and_new_endpoints(ray_start):
+    """The SPA document + the endpoints its pages read (ref analog:
+    dashboard/client/src pages over the REST API)."""
+    from ray_tpu.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get([noop.remote() for _ in range(3)], timeout=60)
+    dash = start_dashboard(port=0)
+    try:
+        def fetch(path):
+            with urllib.request.urlopen(dash.url + path, timeout=10) as r:
+                return r.status, r.read()
+
+        # the SPA document contains every page route + the renderers
+        status, body = fetch("/")
+        assert status == 200
+        for page in (b"overview", b"nodes", b"actors", b"tasks", b"jobs",
+                     b"metrics", b"timeline", b"placement_groups",
+                     b"serve"):
+            assert page in body, f"SPA missing page {page}"
+        assert b"tooltip" in body and b"prefers-color-scheme" in body
+        # summaries (task events flush asynchronously -> poll)
+        deadline = time.time() + 15
+        while True:
+            status, body = fetch("/api/summary/tasks")
+            summary = json.loads(body)
+            assert status == 200
+            if summary["total"] >= 3 or time.time() > deadline:
+                break
+            time.sleep(0.3)
+        assert summary["total"] >= 3
+        status, body = fetch("/api/summary/actors")
+        assert status == 200
+        # timeline has complete-span events for the executed tasks
+        # (FINISHED events flush asynchronously from workers -> poll)
+        deadline = time.time() + 15
+        while True:
+            status, body = fetch("/api/timeline")
+            events = json.loads(body)
+            assert status == 200
+            if any(e.get("ph") == "X" for e in events) or \
+                    time.time() > deadline:
+                break
+            time.sleep(0.3)
+        assert any(e.get("ph") == "X" for e in events)
+        # serve page endpoint answers (empty list when serve is down)
+        status, body = fetch("/api/serve/applications")
+        assert status == 200 and json.loads(body) == []
     finally:
         dash.stop()

@@ -1,0 +1,112 @@
+"""On-demand flamegraph profiling of live workers (+ dashboard wiring).
+
+Analog of the reference's dashboard profiling tests
+(dashboard/modules/reporter/tests — py-spy CPU profile of a worker PID):
+a spinning actor is sampled via SIGUSR1 and its hot function must
+dominate the folded stacks.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt():
+    info = ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield info
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Spinner:
+    def __init__(self):
+        self._stop = False
+
+    def spin_hot_loop(self, seconds: float) -> int:
+        n = 0
+        end = time.monotonic() + seconds
+        while time.monotonic() < end:
+            n += 1
+        return n
+
+    def ping(self):
+        return True
+
+
+def _live_worker_ids():
+    from ray_tpu import state
+
+    return [w["worker_id"] for w in state.list_workers(limit=1000)
+            if w.get("state") not in ("dead",) and w.get("pid")]
+
+
+def test_profile_spinning_actor(rt):
+    from ray_tpu import profiling, state
+
+    a = Spinner.options(max_concurrency=2).remote()
+    ray_tpu.get(a.ping.remote(), timeout=60)
+    fut = a.spin_hot_loop.remote(8.0)  # busy while we sample
+    time.sleep(0.3)
+    workers = [w for w in state.list_workers(limit=1000)
+               if w.get("state") == "actor"]
+    assert workers, "no actor worker found"
+    result = profiling.profile_worker(workers[0]["worker_id"],
+                                      duration_s=1.0, hz=200)
+    assert result["samples"] > 20
+    folded = result["folded"]
+    assert "spin_hot_loop" in folded, folded[:2000]
+    # the hot frame must account for one full thread's worth of samples
+    # (every tick samples EVERY worker thread — executor + io/submitter
+    # threads — so the busy loop is ~1/n_threads of the total)
+    hot = sum(n for s, n in result["stacks"].items()
+              if "spin_hot_loop" in s)
+    assert hot >= result["samples"] * 0.1
+    assert hot >= 20
+    assert ray_tpu.get(fut, timeout=60) > 0
+
+
+def test_profile_self_driver(rt):
+    from ray_tpu import profiling
+
+    def burn():
+        x = 0
+        for i in range(3_000_000):
+            x += i
+        return x
+
+    import threading
+
+    t = threading.Thread(target=burn)
+    t.start()
+    result = profiling.profile_self(duration_s=0.5, hz=200)
+    t.join()
+    assert result["samples"] > 10
+    assert "burn" in result["folded"]
+
+
+def test_profile_via_dashboard_endpoint(rt):
+    from ray_tpu import state
+    from ray_tpu.dashboard import start_dashboard
+
+    a = Spinner.options(max_concurrency=2).remote()
+    ray_tpu.get(a.ping.remote(), timeout=60)
+    fut = a.spin_hot_loop.remote(8.0)
+    time.sleep(0.3)
+    workers = [w for w in state.list_workers(limit=1000)
+               if w.get("state") == "actor"]
+    dash = start_dashboard(port=0)
+    try:
+        url = (f"{dash.url}/api/profile?worker_id="
+               f"{workers[0]['worker_id']}&duration_s=0.5&hz=200")
+        with urllib.request.urlopen(url, timeout=30) as r:
+            body = json.loads(r.read())
+        assert body["samples"] > 10
+        assert "spin_hot_loop" in body["folded"]
+    finally:
+        dash.stop()
+    assert ray_tpu.get(fut, timeout=60) > 0
