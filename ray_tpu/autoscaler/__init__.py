@@ -10,6 +10,11 @@ homogeneous per node type.
 
 from ray_tpu.autoscaler.autoscaler import (Autoscaler, AutoscalingPolicy,
                                            LocalNodeProvider, NodeProvider)
+from ray_tpu.autoscaler.batching_provider import (BatchingNodeProvider,
+                                                  CloudAPI,
+                                                  FakeGkeTpuCloud,
+                                                  ScaleRequest)
 
 __all__ = ["Autoscaler", "AutoscalingPolicy", "NodeProvider",
-           "LocalNodeProvider"]
+           "LocalNodeProvider", "BatchingNodeProvider", "CloudAPI",
+           "FakeGkeTpuCloud", "ScaleRequest"]

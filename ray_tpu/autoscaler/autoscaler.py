@@ -40,6 +40,11 @@ class NodeProvider:
     node) and therefore never scale down either. The autoscaler logs a
     warning when a launch stays unmatched past the grace period."""
 
+    #: declarative providers (BatchingNodeProvider) return transient ids
+    #: from create_node; the autoscaler adopts the materialized nodes by
+    #: label instead of tracking the launch ids
+    declarative: bool = False
+
     def create_node(self) -> str:
         """Launch one node; returns a provider node id (see the label
         contract above)."""
@@ -165,35 +170,61 @@ class Autoscaler:
                 len(self._head._pending_pg)
 
     def update(self):
-        self._reconcile_membership()
+        # ONE provider poll per update: a declarative provider resets its
+        # pending ScaleRequest here (ref: batching_node_provider's
+        # non_terminated_nodes contract)
+        alive = list(self._provider.non_terminated_nodes())
+        declarative = getattr(self._provider, "declarative", False)
+        self._reconcile_membership(alive if declarative else None)
         demand = self.pending_demand()
-        alive = self._provider.non_terminated_nodes()
+        count = len(alive)
         if demand > 0:
             per_node = max(self._provider_cpus_per_node(), 1)
             want = math.ceil(demand / per_node)
-            capacity = self.policy.max_workers - len(alive)
+            capacity = self.policy.max_workers - count
             n = min(want, self.policy.max_launch_batch, max(capacity, 0))
             for _ in range(n):
                 pid = self._provider.create_node()
-                self._tracked.append(_TrackedNode(pid))
+                if not declarative:
+                    self._tracked.append(_TrackedNode(pid))
                 self.num_launches += 1
+                count += 1
         else:
-            self._scale_down()
+            count -= self._scale_down(count)
         # honor min_workers
-        deficit = self.policy.min_workers - \
-            len(self._provider.non_terminated_nodes())
-        for _ in range(max(deficit, 0)):
+        for _ in range(max(self.policy.min_workers - count, 0)):
             pid = self._provider.create_node()
-            self._tracked.append(_TrackedNode(pid))
+            if not declarative:
+                self._tracked.append(_TrackedNode(pid))
             self.num_launches += 1
+            count += 1
+        # declarative providers flush all of the above as ONE request
+        post = getattr(self._provider, "post_process", None)
+        if post is not None:
+            post()
 
     def _provider_cpus_per_node(self) -> int:
         return getattr(self._provider, "num_cpus", 1)
 
-    def _reconcile_membership(self):
+    def _reconcile_membership(self, provider_ids=None):
         """Match provider nodes to registered head nodes (by the launch
         label — adopting ANY new node would let scale-down evict remote
-        drivers or hand-joined agents) + track idleness."""
+        drivers or hand-joined agents) + track idleness.
+
+        ``provider_ids`` (declarative providers only): the cloud's
+        current node list — ids the cloud materialized that we aren't
+        tracking yet are adopted, and tracked ids the cloud no longer
+        reports are dropped."""
+        if provider_ids is not None:
+            tracked_ids = {t.provider_id for t in self._tracked}
+            for pid in provider_ids:
+                if pid not in tracked_ids:
+                    self._tracked.append(_TrackedNode(pid))
+            gone = set(tracked_ids) - set(provider_ids)
+            for t in list(self._tracked):
+                if t.provider_id in gone:
+                    self._tracked.remove(t)
+                    self._known_idxs.discard(t.node_idx)
         with self._head._lock:
             remote = {idx: n for idx, n in self._head.nodes.items()
                       if n.is_remote and n.alive}
@@ -230,10 +261,11 @@ class Autoscaler:
             elif t.idle_since is None:
                 t.idle_since = now
 
-    def _scale_down(self):
+    def _scale_down(self, alive: int) -> int:
+        """Terminate idle tracked nodes; returns how many were removed."""
         now = time.monotonic()
         floor = self.policy.min_workers
-        alive = len(self._provider.non_terminated_nodes())
+        removed = 0
         for t in list(self._tracked):
             if alive <= floor:
                 break
@@ -251,3 +283,5 @@ class Autoscaler:
             self._known_idxs.discard(t.node_idx)
             self.num_terminations += 1
             alive -= 1
+            removed += 1
+        return removed
