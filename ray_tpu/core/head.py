@@ -1522,6 +1522,74 @@ class Head:
     def _h_worker_exit(self, conn, rid):
         pass  # connection close handles cleanup
 
+    # ------------------------------------------------ cross-language calls
+
+    def _h_xlang_call(self, conn, rid, payload):
+        """C++/non-Python frontend task submission (ref analog:
+        cpp/src/ray/runtime/task/task_submitter.h:26 + the Ray Client
+        proxy pattern, util/client/server/proxier.py — a thin client
+        submits by FUNCTION DESCRIPTOR and the Python side executes).
+
+        Request: JSON {"op": "submit", "function": "module:qualname",
+        "args": [...], "kwargs": {...}, "options": {...},
+        "timeout_s": 300}. The reply is a RAW frame of JSON (never
+        pickle) keyed by this request's rid, so a C client only needs to
+        frame-skip pickled traffic and parse JSON.
+        """
+        import json as _json
+
+        req = _json.loads(bytes(payload).decode()
+                          if isinstance(payload, (bytes, bytearray,
+                                                  memoryview))
+                          else payload)
+
+        def run():
+            try:
+                out = {"rid": rid, "status": "ok",
+                       "result": self._xlang_execute(req)}
+            except BaseException as e:  # noqa: BLE001 — ship to client
+                out = {"rid": rid, "status": "error", "error": repr(e)}
+            try:
+                conn.send_with_raw(
+                    P.OK, rid,
+                    raw=_json.dumps(out, default=repr).encode())
+            except P.ConnectionLost:
+                pass
+
+        # off the IO thread: submission blocks on lease grant + execution
+        threading.Thread(target=run, daemon=True, name="xlang").start()
+
+    def _xlang_execute(self, req: dict):
+        op = req.get("op", "submit")
+        if op == "cluster":
+            with self._lock:
+                alive = [n for n in self.nodes.values() if n.alive]
+                totals: Dict[str, float] = {}
+                for n in alive:
+                    for k, v in n.resources.total.to_dict().items():
+                        totals[k] = totals.get(k, 0.0) + v
+                return {"nodes": len(alive), "resources": totals}
+        if op != "submit":
+            raise ValueError(f"unknown xlang op {op!r}")
+        import importlib
+
+        import ray_tpu
+
+        target = req["function"]
+        mod_name, _, qual = target.partition(":")
+        if not qual:
+            raise ValueError(
+                f"function {target!r} must be 'module:qualname'")
+        obj = importlib.import_module(mod_name)
+        for part in qual.split("."):
+            obj = getattr(obj, part)
+        rf = ray_tpu.remote(obj)
+        opts = req.get("options") or {}
+        if opts:
+            rf = rf.options(**opts)
+        ref = rf.remote(*req.get("args", []), **(req.get("kwargs") or {}))
+        return ray_tpu.get(ref, timeout=float(req.get("timeout_s", 300)))
+
     _HANDLERS = {
         P.REGISTER: _h_register,
         P.LEASE_REQUEST: _h_lease_request,
@@ -1559,6 +1627,7 @@ class Head:
         P.STATE_QUERY: _h_state_query,
         P.SEAL_ABORTED: _h_seal_aborted,
         P.METRICS_REPORT: _h_metrics_report,
+        P.XLANG_CALL: _h_xlang_call,
     }
 
     def _forward_to_worker(self, worker_id: str, mt: int, *fields):

@@ -101,6 +101,13 @@ SEAL_ABORTED = 65       # owner->head: ([oid_bins],) the creating task failed
                         # blocked locate waiters instead of hanging them
 METRICS_REPORT = 66     # ([(kind, name, desc, meta, tags_key, value)],)
                         # per-process metric deltas -> head aggregate
+XLANG_CALL = 67         # (json_bytes,) cross-language frontend (C++ task
+                        # submission): {"op": "submit", "function":
+                        # "module:qualname", "args": [...]} — the head
+                        # executes on behalf of the client and replies
+                        # with a RAW frame of JSON {"rid", "status",
+                        # "result"|"error"} (raw so non-Python clients
+                        # never parse pickle)
 
 # High bit of the length prefix marks a RAW frame: the payload is
 # unpickled bytes (bulk data follows its pickled header message). Sending

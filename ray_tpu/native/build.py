@@ -18,22 +18,40 @@ TARGETS = {
     "libshm_store.so": ["shm_store.cc"],
 }
 
+# standalone executables (the C++ task-submission frontend)
+BINARIES = {
+    "task_client": ["task_client.cc"],
+}
+
 CXXFLAGS = ["-O2", "-fPIC", "-shared", "-std=c++17", "-Wall"]
+BINFLAGS = ["-O2", "-std=c++17", "-Wall"]
 LDFLAGS = ["-lpthread", "-lrt"]
+
+
+def _stale(out: str, srcs) -> bool:
+    return not os.path.exists(out) or any(
+        os.path.getmtime(out) < os.path.getmtime(s) for s in srcs)
 
 
 def build(force: bool = False) -> None:
     for lib, sources in TARGETS.items():
         out = os.path.join(_DIR, lib)
         srcs = [os.path.join(_DIR, s) for s in sources]
-        if (
-            not force
-            and os.path.exists(out)
-            and all(os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs)
-        ):
+        if not force and not _stale(out, srcs):
             continue
         cmd = ["g++", *CXXFLAGS, "-o", out, *srcs, *LDFLAGS]
         subprocess.run(cmd, check=True, cwd=_DIR)
+
+
+def build_binary(name: str, force: bool = False) -> str:
+    """Compile one executable from BINARIES; returns its path."""
+    sources = BINARIES[name]
+    out = os.path.join(_DIR, name)
+    srcs = [os.path.join(_DIR, s) for s in sources]
+    if force or _stale(out, srcs):
+        subprocess.run(["g++", *BINFLAGS, "-o", out, *srcs, *LDFLAGS],
+                       check=True, cwd=_DIR)
+    return out
 
 
 def lib_path(name: str) -> str:

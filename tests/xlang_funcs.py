@@ -1,0 +1,17 @@
+"""Functions the C++ task client invokes by descriptor (test helper;
+analog of the registered functions the reference's cpp cluster-mode
+tests call cross-language)."""
+
+
+def add(a, b):
+    return a + b
+
+
+def greet(name):
+    return f"hello {name}"
+
+
+def pid():
+    import os
+
+    return os.getpid()
