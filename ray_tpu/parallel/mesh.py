@@ -31,9 +31,12 @@ from jax.sharding import Mesh
 # Canonical axis order: outermost (DCN-friendly, infrequent comm) first,
 # innermost (ICI-hot, per-layer comm) last — matches how contiguous device
 # order maps onto the torus so tensor/sequence collectives ride nearest
-# neighbours.
+# neighbours. "slice" (multi-slice DCN data parallelism — gradient
+# all-reduce across pod slices, scaling-book hybrid-mesh recipe) only
+# appears when MeshSpec(slices=) > 1.
 MESH_AXES: Tuple[str, ...] = (
     "data", "fsdp", "expert", "pipeline", "sequence", "tensor")
+DCN_AXIS = "slice"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,6 +46,15 @@ class MeshSpec:
     Example::
 
         MeshSpec(fsdp=-1, tensor=4).build()   # on 32 chips -> (1,8,1,1,1,4)
+
+    ``slices > 1`` builds a hybrid ICI x DCN mesh: the ICI axes above
+    describe ONE pod slice, and a leading "slice" axis spans slices over
+    DCN (greenfield per SURVEY §2.3 — the reference has no multi-slice
+    story). Devices are grouped by their ``slice_index`` attribute when
+    the backend reports one (real multi-slice TPU), else contiguously
+    (virtual/CPU simulation)::
+
+        MeshSpec(fsdp=-1, slices=2).build()  # 8 devs -> slice=2, fsdp=4
     """
 
     data: int = 1
@@ -51,8 +63,17 @@ class MeshSpec:
     pipeline: int = 1
     sequence: int = 1
     tensor: int = 1
+    slices: int = 1
 
     def sizes(self, n_devices: int) -> Tuple[int, ...]:
+        """Per-slice ICI axis sizes over n_devices // slices."""
+        if self.slices < 1:
+            raise ValueError("slices must be >= 1")
+        if n_devices % self.slices:
+            raise ValueError(
+                f"{n_devices} devices not divisible into {self.slices} "
+                f"slices")
+        per_slice = n_devices // self.slices
         raw = [self.data, self.fsdp, self.expert, self.pipeline,
                self.sequence, self.tensor]
         fills = [i for i, v in enumerate(raw) if v == -1]
@@ -60,20 +81,42 @@ class MeshSpec:
             raise ValueError("at most one mesh axis may be -1 (fill)")
         fixed = math.prod(v for v in raw if v != -1)
         if fills:
-            if n_devices % fixed:
+            if per_slice % fixed:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by fixed axes {fixed}")
-            raw[fills[0]] = n_devices // fixed
-        elif fixed != n_devices:
+                    f"{per_slice} per-slice devices not divisible by "
+                    f"fixed axes {fixed}")
+            raw[fills[0]] = per_slice // fixed
+        elif fixed != per_slice:
             raise ValueError(
-                f"mesh {raw} needs {fixed} devices, have {n_devices}")
+                f"mesh {raw} needs {fixed} devices/slice, have {per_slice}")
         return tuple(raw)
 
     def build(self, devices: Optional[Sequence] = None) -> Mesh:
         devices = list(devices if devices is not None else jax.devices())
         shape = self.sizes(len(devices))
-        arr = np.asarray(devices).reshape(shape)
-        return Mesh(arr, MESH_AXES)
+        if self.slices == 1:
+            arr = np.asarray(devices).reshape(shape)
+            return Mesh(arr, MESH_AXES)
+        # hybrid ICI x DCN: group devices by hardware slice so the DCN
+        # axis really crosses slices and every ICI axis stays intra-slice
+        per = len(devices) // self.slices
+        by_slice = {}
+        if all(getattr(d, "slice_index", None) is not None
+               for d in devices):
+            for d in devices:
+                by_slice.setdefault(d.slice_index, []).append(d)
+            if len(by_slice) != self.slices or \
+                    any(len(v) != per for v in by_slice.values()):
+                raise ValueError(
+                    f"hardware reports {len(by_slice)} slices with sizes "
+                    f"{[len(v) for v in by_slice.values()]}; "
+                    f"spec wants {self.slices} x {per}")
+            groups = [by_slice[k] for k in sorted(by_slice)]
+        else:  # simulation: contiguous split
+            groups = [devices[i * per:(i + 1) * per]
+                      for i in range(self.slices)]
+        arr = np.asarray(groups).reshape((self.slices,) + shape)
+        return Mesh(arr, (DCN_AXIS,) + MESH_AXES)
 
 
 def make_mesh(n_devices: Optional[int] = None, **axis_sizes) -> Mesh:

@@ -110,14 +110,22 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
 
 def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sequence",
                    causal: bool = True, scale: Optional[float] = None,
-                   batch_axes=("data", "fsdp"), head_axis: str = "tensor"):
+                   batch_axes=None, head_axis: str = "tensor"):
     """Causal self-attention with the sequence dim sharded over `axis_name`.
 
     q, k, v: [batch, seq, heads, head_dim] (seq globally sharded).
     Degenerates to plain (still flash-style) attention when the sequence
     axis has size 1, so callers can use it unconditionally.
+
+    ``batch_axes`` defaults to every data-like axis PRESENT in the mesh
+    (slice/data/fsdp) — a hybrid multi-slice mesh must keep the batch
+    sharded over DCN here, or shard_map would silently all-gather q/k/v
+    across slices.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if batch_axes is None:
+        batch_axes = tuple(a for a in ("slice", "data", "fsdp")
+                           if a in mesh.axis_names)
     spec = P(batch_axes, axis_name, head_axis, None)
     fn = shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,

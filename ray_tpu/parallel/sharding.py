@@ -15,10 +15,13 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # logical axis -> mesh axis (or tuple of mesh axes, or None = replicate).
-# Batch shards over every data-like axis; embed shards over fsdp (ZeRO-3);
-# heads/mlp/vocab shard over tensor (Megatron); seq over sequence (ring CP).
+# Batch shards over every data-like axis (incl. the DCN "slice" axis of
+# hybrid multi-slice meshes — pure data parallelism is the only traffic
+# slow enough for DCN); embed shards over fsdp (ZeRO-3); heads/mlp/vocab
+# shard over tensor (Megatron); seq over sequence (ring CP). Axes absent
+# from a given mesh are dropped at spec-build time.
 DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
-    ("batch", ("data", "fsdp")),
+    ("batch", ("slice", "data", "fsdp")),
     ("seq", "sequence"),
     ("embed", "fsdp"),
     ("heads", "tensor"),
@@ -38,12 +41,21 @@ def rules_to_dict(rules=None) -> dict:
     return dict(rules if rules is not None else DEFAULT_RULES)
 
 
-def logical_to_spec(logical: Sequence[Optional[str]], rules=None) -> P:
-    """Translate logical axis names into a PartitionSpec via the rule table."""
+def logical_to_spec(logical: Sequence[Optional[str]], rules=None,
+                    mesh_axes: Optional[Sequence[str]] = None) -> P:
+    """Translate logical axis names into a PartitionSpec via the rule
+    table. ``mesh_axes`` (when given) drops rule axes the target mesh
+    doesn't have — e.g. "slice" on a single-slice mesh."""
     table = rules_to_dict(rules)
     out, used = [], set()
     for name in logical:
         mesh_ax = table.get(name) if name is not None else None
+        if mesh_ax is not None and mesh_axes is not None:
+            if isinstance(mesh_ax, tuple):
+                mesh_ax = tuple(a for a in mesh_ax if a in mesh_axes) \
+                    or None
+            elif mesh_ax not in mesh_axes:
+                mesh_ax = None
         # A mesh axis may appear only once per spec; later duplicates replicate.
         if mesh_ax is None:
             out.append(None)
@@ -61,7 +73,8 @@ def logical_to_spec(logical: Sequence[Optional[str]], rules=None) -> P:
 
 def logical_sharding(mesh: Mesh, logical: Sequence[Optional[str]],
                      rules=None) -> NamedSharding:
-    return NamedSharding(mesh, logical_to_spec(logical, rules))
+    return NamedSharding(mesh,
+                         logical_to_spec(logical, rules, mesh.axis_names))
 
 
 def tree_shardings(mesh: Mesh, logical_tree: Any, rules=None) -> Any:
