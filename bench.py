@@ -17,11 +17,18 @@ Honesty guards (VERDICT round 1 flagged a physically impossible 27,500% MFU):
 """
 
 import json
+import os
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
+
+# The host sitecustomize force-registers the axon TPU backend, overriding
+# the standard JAX_PLATFORMS env var; restore the expected semantics so
+# `JAX_PLATFORMS=cpu python bench.py` really is a CPU smoke test.
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 # bf16 peak FLOP/s per chip by TPU generation (public spec sheets).
 _PEAK_FLOPS = {
@@ -82,9 +89,13 @@ def _calibrate(peak: float) -> float:
 
 
 def _candidate(name: str):
-    """Flagship candidates, largest first. The llama configs train with
-    bf16 master params + bf16 adam mu + fp32 nu — measured 49.8% MFU for
-    the 1B flagship on a single 16 GiB v5e chip."""
+    """Benchmark candidates. The llama configs train with bf16 master
+    params + bf16 adam mu + fp32 nu — measured 50.3% MFU for the 1B
+    flagship on a single 16 GiB v5e chip (BENCH_r03). The 8b ladder
+    (bs=1, full remat, descending seq) exists so the north-star geometry
+    gets a real number wherever HBM allows (v5p: 95 GiB fits the 64 GiB
+    lean-adam state; v5e 16 GiB cannot hold 8B bf16 params at all — the
+    attempt is recorded honestly either way)."""
     from ray_tpu.models import (
         gpt2_small_config,
         llama3_8b_config,
@@ -94,18 +105,33 @@ def _candidate(name: str):
 
     bf16 = dict(param_dtype=jnp.bfloat16)
     lean_opt = dict(mu_dtype=jnp.bfloat16)
+    remat = dict(remat=True, remat_policy="nothing")
     table = {
-        "llama3-8b": (llama3_8b_config(max_seq_len=2048, **bf16),
-                      4, 2048, 5, lean_opt),
         "llama3-1b": (llama3_1b_config(max_seq_len=2048, **bf16),
                       4, 2048, 10, lean_opt),
+        "llama3-8b": (llama3_8b_config(max_seq_len=2048, **bf16),
+                      4, 2048, 3, lean_opt),
+        "llama3-8b-bs1-s2048": (
+            llama3_8b_config(max_seq_len=2048, **bf16, **remat),
+            1, 2048, 3, lean_opt),
+        "llama3-8b-bs1-s1024": (
+            llama3_8b_config(max_seq_len=1024, **bf16, **remat),
+            1, 1024, 3, lean_opt),
+        "llama3-8b-bs1-s512": (
+            llama3_8b_config(max_seq_len=512, **bf16, **remat),
+            1, 512, 3, lean_opt),
         "gpt2-small": (gpt2_small_config(), 16, 1024, 20, {}),
         "tiny-cpu": (tiny_config(max_seq_len=128), 8, 128, 5, {}),
     }
     return table[name]
 
 
-CANDIDATE_ORDER = ("llama3-8b", "llama3-1b", "gpt2-small")
+# Flagship (known to fit + the standing MFU record) runs FIRST so the
+# artifact always contains a real number before any speculative 8b
+# attempt can burn budget; then the 8b ladder largest-seq first.
+CANDIDATE_ORDER = ("llama3-1b", "llama3-8b", "llama3-8b-bs1-s2048",
+                   "llama3-8b-bs1-s1024", "llama3-8b-bs1-s512",
+                   "gpt2-small")
 
 
 def _run_single(cfg_name: str) -> None:
@@ -169,59 +195,175 @@ def _run_single(cfg_name: str) -> None:
 
 
 def main():
-    """Try candidates largest-first, EACH IN ITS OWN SUBPROCESS.
+    """Run candidates EACH IN ITS OWN SUBPROCESS under a global deadline.
 
-    Two observed backend behaviors force this structure: (a) a failed
-    too-big allocation wedges this backend's allocator so later small
-    allocations in the same process also fail (in-process step-down would
-    cascade to total failure), and (b) allocation probes lie (multi-100-GiB
-    ``jnp.zeros`` "succeeds" lazily), so fit can only be tested by really
-    running the config. The parent never touches the device — the tunnel
-    backend serializes access to a single holder.
+    Two observed backend behaviors force the subprocess structure: (a) a
+    failed too-big allocation wedges this backend's allocator so later
+    small allocations in the same process also fail, and (b) allocation
+    probes lie (multi-100-GiB ``jnp.zeros`` "succeeds" lazily), so fit can
+    only be tested by really running the config. The parent never touches
+    the device — the tunnel backend serializes access to a single holder.
+
+    Round-4 postmortem additions (BENCH_r04 was rc=124 with parsed=null):
+      * a global deadline (RAY_TPU_BENCH_BUDGET_S, default 1500 s) with a
+        per-child cap, so a wedged child can never consume the driver's
+        whole budget;
+      * the flagship config runs first to bank a real number before any
+        speculative 8b rung;
+      * children are SIGTERMed with a grace period before SIGKILL (a
+        SIGKILLed mid-run TPU process wedges the tunnel for *subsequent*
+        processes);
+      * a child *timeout* (as opposed to a clean failure) marks the
+        backend suspect and stops further TPU attempts;
+      * the parent traps SIGTERM and ALWAYS prints exactly one JSON line
+        — best successful config as the headline, every attempt recorded.
     """
     import os
+    import signal
     import subprocess
 
     if len(sys.argv) > 2 and sys.argv[1] == "--config":
         _run_single(sys.argv[2])
         return
     here = os.path.abspath(__file__)
+    t_start = time.monotonic()
+    budget = float(os.environ.get("RAY_TPU_BENCH_BUDGET_S", "1500"))
+    deadline = t_start + budget
+    attempts = []   # [{config, status, ...}]
+    results = []    # successful child JSON dicts
+    live = []       # the at-most-one in-flight child Popen
+    emitted = []    # idempotence flag for emit_and_exit
+
+    def emit_and_exit(rc_hint=None, hard=False):
+        if emitted:
+            return
+        emitted.append(True)
+        for p in live:  # don't orphan an in-flight TPU child
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        best = max(results, key=lambda r: r.get("vs_baseline", 0.0),
+                   default=None)
+        if best is not None:
+            out = dict(best)
+            out["attempts"] = attempts
+            rc = 0
+        else:
+            out = {"metric": "train_step_tokens_per_sec_per_chip",
+                   "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+                   "error": "no candidate config produced a measurement",
+                   "attempts": attempts}
+            rc = rc_hint if rc_hint is not None else 1
+        print(json.dumps(out))
+        sys.stdout.flush()
+        # from a signal handler, unwinding through arbitrary frames is not
+        # safe (observed: SystemExit re-entering during atexit) — hard-exit
+        os._exit(rc) if hard else sys.exit(rc)
+
+    signal.signal(signal.SIGTERM,
+                  lambda *_: emit_and_exit(1, hard=True))
 
     def run_child(cfg_name: str):
+        """Returns (status, proc_or_None); status in
+        {ok, failed, cpu_backend, timeout, no_budget}."""
+        remaining = deadline - time.monotonic()
+        if remaining < 45:
+            attempts.append({"config": cfg_name, "status": "no_budget"})
+            return "no_budget", None
+        cap = min(remaining - 30, 720.0)
+        proc = subprocess.Popen(
+            [sys.executable, here, "--config", cfg_name],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        live.append(proc)
         try:
-            return subprocess.run(
-                [sys.executable, here, "--config", cfg_name],
-                capture_output=True, text=True, timeout=3600)
-        except subprocess.TimeoutExpired as e:
-            # a wedged child (hung allocator) must step down, not crash
-            # the bench without its JSON line
-            print(f"# {cfg_name} timed out after {e.timeout}s",
+            out, err = proc.communicate(timeout=cap)
+        except subprocess.TimeoutExpired:
+            print(f"# {cfg_name} timed out after {cap:.0f}s; terminating",
                   file=sys.stderr)
-            return None
-
-    for name in CANDIDATE_ORDER:
-        proc = run_child(name)
-        if proc is None:
-            continue
-        sys.stderr.write(proc.stderr)
-        if proc.returncode == 0 and proc.stdout.strip():
-            sys.stdout.write(proc.stdout)
-            return
+            proc.terminate()  # graceful first: SIGKILL wedges the tunnel
+            try:
+                out, err = proc.communicate(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out, err = proc.communicate()
+            live.remove(proc)
+            sys.stderr.write((err or "")[-4000:])
+            # the child may have finished measuring and wedged during
+            # teardown (a documented tunnel failure mode) — salvage any
+            # JSON it managed to print before declaring a timeout
+            for line in reversed((out or "").strip().splitlines()):
+                try:
+                    parsed = json.loads(line)
+                except ValueError:
+                    continue
+                if parsed.get("value"):
+                    attempts.append({"config": cfg_name,
+                                     "status": "ok_salvaged_after_timeout",
+                                     "tokens_per_sec": parsed.get("value"),
+                                     "mfu": parsed.get("mfu")})
+                    results.append(parsed)
+                    break
+            else:
+                attempts.append({"config": cfg_name, "status": "timeout",
+                                 "timeout_s": round(cap, 1)})
+            return "timeout", None
+        live.remove(proc)
+        sys.stderr.write(err or "")
         if proc.returncode == 3:
-            # CPU backend: run the smoke-test config directly
-            proc = run_child("tiny-cpu")
-            if proc is not None:
-                sys.stderr.write(proc.stderr)
-                sys.stdout.write(proc.stdout)
-                sys.exit(proc.returncode)
-            break
-        print(f"# {name} failed (rc={proc.returncode}); stepping down",
+            attempts.append({"config": cfg_name, "status": "cpu_backend"})
+            return "cpu_backend", None
+        if proc.returncode == 0 and out.strip():
+            try:
+                parsed = json.loads(out.strip().splitlines()[-1])
+            except ValueError:
+                attempts.append({"config": cfg_name, "status": "failed",
+                                 "error": "unparseable child output"})
+                return "failed", None
+            attempts.append({"config": cfg_name, "status": "ok",
+                             "tokens_per_sec": parsed.get("value"),
+                             "mfu": parsed.get("mfu")})
+            results.append(parsed)
+            return "ok", parsed
+        tail = (err or "").strip().splitlines()[-3:]
+        attempts.append({"config": cfg_name, "status": "failed",
+                         "rc": proc.returncode,
+                         "error": " | ".join(tail)[-400:]})
+        print(f"# {cfg_name} failed (rc={proc.returncode})",
               file=sys.stderr)
-    print(json.dumps({
-        "metric": "train_step_tokens_per_sec_per_chip",
-        "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
-        "error": "every candidate config failed on this device"}))
-    sys.exit(1)
+        return "failed", None
+
+    flagship_ok = False
+    for name in CANDIDATE_ORDER:
+        if name.startswith("llama3-8b") and not flagship_ok:
+            # flagship already failed/timed out; don't gamble what's left
+            # of the budget on configs 6x bigger
+            continue
+        if name == "gpt2-small" and flagship_ok:
+            break  # fallback config is pointless once the flagship landed
+        status, _ = run_child(name)
+        if status == "ok":
+            if name == "llama3-1b":
+                flagship_ok = True
+                continue  # go on to attempt the 8b ladder
+            break  # an 8b rung (or fallback) landed; done
+        if status == "timeout":
+            break  # backend suspect: stop touching the device
+        if status == "no_budget":
+            break
+        if status == "cpu_backend":
+            run_child("tiny-cpu")
+            break
+        if status == "failed" and name == "llama3-1b":
+            # one retry with backoff — r4's UNAVAILABLE was transient-class
+            time.sleep(10)
+            if run_child(name)[0] == "ok":
+                flagship_ok = True
+            # either way keep going: the startswith guard skips the 8b
+            # ladder when the flagship failed, falling through to the
+            # gpt2-small step-down so the artifact still gets a number
+            continue
+    emit_and_exit()
 
 
 if __name__ == "__main__":
