@@ -297,7 +297,7 @@ def main():
                     parsed = json.loads(line)
                 except ValueError:
                     continue
-                if parsed.get("value"):
+                if parsed.get("value") and "error" not in parsed:
                     attempts.append({"config": cfg_name,
                                      "status": "ok_salvaged_after_timeout",
                                      "tokens_per_sec": parsed.get("value"),

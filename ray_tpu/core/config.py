@@ -93,6 +93,17 @@ class Config:
     # Override autodetected TPU topology, e.g. "v5p-64".
     tpu_accelerator_type: str = ""
 
+    # --- cross-language gateway ---
+    # Comma-separated module-prefix allowlist for XLANG_CALL (the framed
+    # JSON task-submission endpoint used by the C++/Java clients). Empty =
+    # allow any importable module, matching the trust model of the rest of
+    # the protocol: every peer that can reach the head socket can already
+    # submit pickled tasks (pickle implies arbitrary code execution), the
+    # same cluster-internal trust boundary as the reference's GCS. Set
+    # e.g. "myapp.,mylib.jobs" to restrict non-Python clients to known
+    # entry points.
+    xlang_allowed_prefixes: str = ""
+
     def __post_init__(self):
         for f in fields(self):
             env = os.environ.get(_ENV_PREFIX + f.name.upper())

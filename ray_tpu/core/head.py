@@ -1580,6 +1580,17 @@ class Head:
         if not qual:
             raise ValueError(
                 f"function {target!r} must be 'module:qualname'")
+        allowed = get_config().xlang_allowed_prefixes
+        if allowed:
+            def _matches(p: str) -> bool:
+                # module-boundary aware: "myapp" allows myapp and myapp.sub
+                # but NOT myapp_evil; "myapp." allows the subtree only
+                base = p.rstrip(".")
+                return mod_name == base or mod_name.startswith(base + ".")
+            prefixes = [p.strip() for p in allowed.split(",") if p.strip()]
+            if not any(_matches(p) for p in prefixes):
+                raise PermissionError(
+                    f"module {mod_name!r} is not in xlang_allowed_prefixes")
         obj = importlib.import_module(mod_name)
         for part in qual.split("."):
             obj = getattr(obj, part)

@@ -299,6 +299,13 @@ class InferenceEngine:
         self._lock = threading.Lock()   # guards step() vs concurrent step()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # set when the step loop died on an unrecoverable error (device /
+        # XLA failure); submit() raises from then on instead of queueing
+        # work that nothing will ever drain. _death_lock orders submit's
+        # check+enqueue against _die's drain (NOT _lock — that is held for
+        # the whole of a step(), and submissions must not block on it)
+        self._fatal: Optional[BaseException] = None
+        self._death_lock = threading.Lock()
         # running counters for benchmarking / observability
         self.stats = {"prefills": 0, "decode_steps": 0, "tokens_out": 0,
                       "requests_done": 0}
@@ -309,7 +316,9 @@ class InferenceEngine:
                max_new_tokens: Optional[int] = None) -> _Request:
         """Enqueue a prompt; returns the request (``result()`` to wait)."""
         req = self._make_request(prompt, max_new_tokens, stream=False)
-        self._queue.put(req)
+        with self._death_lock:
+            self._check_alive()
+            self._queue.put(req)
         self._work.set()
         return req
 
@@ -318,7 +327,9 @@ class InferenceEngine:
         """Enqueue a prompt; returns an iterator of token ids that ends
         when the sequence finishes (eos or length)."""
         req = self._make_request(prompt, max_new_tokens, stream=True)
-        self._queue.put(req)
+        with self._death_lock:
+            self._check_alive()
+            self._queue.put(req)
         self._work.set()
 
         def gen():
@@ -470,7 +481,16 @@ class InferenceEngine:
 
         def loop():
             while not self._stop.is_set():
-                if not self.step():
+                try:
+                    busy = self.step()
+                except BaseException as e:
+                    # an error escaping step() (device/XLA failure at
+                    # dispatch or fetch) kills the engine: error out every
+                    # in-flight and queued request so no waiter hangs, and
+                    # refuse new submissions
+                    self._die(e)
+                    return
+                if not busy:
                     # idle: sleep until a submission arrives
                     self._work.clear()
                     if not self._queue.qsize():
@@ -479,6 +499,33 @@ class InferenceEngine:
                                         daemon=True)
         self._thread.start()
         return self
+
+    def _check_alive(self):
+        if self._fatal is not None:
+            raise RuntimeError(
+                "InferenceEngine is dead (step loop failed)") \
+                from self._fatal
+
+    def _die(self, exc: BaseException):
+        """Mark the engine dead and fail every known request."""
+        failed = [r for r in self._slot_req if r is not None]
+        self._slot_req = [None] * self.slots
+        with self._death_lock:
+            # after this block no submit() can enqueue: _fatal is visible
+            # to every subsequent check, and the queue is drained
+            self._fatal = exc
+            while True:
+                try:
+                    failed.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+        for _, snap in self._inflight:
+            failed.extend(req for _, req, _ in snap)
+        self._inflight = []
+        for req in failed:
+            if not req.done.is_set():
+                req.error = exc
+                req.finish("error")
 
     def shutdown(self):
         self._stop.set()

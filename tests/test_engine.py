@@ -249,22 +249,44 @@ def test_long_generation_does_not_stall_batch(model):
                           max_new_tokens=32)
     long_req = eng.submit([1, 2, 3], max_new_tokens=32)
     short_req = eng.submit([4, 5, 6], max_new_tokens=2)
+    third = None
     done_at = {}
     for i in range(200):
         eng.step()
-        for name, r in (("short", short_req), ("long", long_req)):
+        if short_req.done.is_set() and third is None:
+            # the freed slot must pick this up while long still runs
+            third = eng.submit([7, 8], max_new_tokens=2)
+        for name, r in [("short", short_req), ("long", long_req)] + \
+                ([("third", third)] if third is not None else []):
             if r.done.is_set() and name not in done_at:
                 done_at[name] = i
-        if len(done_at) == 2:
+        if len(done_at) == 3:
             break
     assert done_at["short"] < done_at["long"]
-    # a third request must have been admitted into the freed slot BEFORE
-    # the long one finished
-    third = eng.submit([7, 8], max_new_tokens=2)
-    for _ in range(50):
-        if third.done.is_set():
-            break
-        eng.step()
-    assert third.done.is_set() and not long_req.done.is_set() or \
-        long_req.done.is_set()
+    # continuous batching: the third request entered the freed slot and
+    # FINISHED before the long request did
+    assert "third" in done_at and done_at["third"] < done_at["long"]
     assert list(third.tokens) == _reference_tokens(params, cfg, [7, 8], 32)[:2]
+
+
+def test_step_loop_death_fails_all_waiters(model):
+    """A fatal error escaping step() must error out every in-flight and
+    queued request and make further submissions raise (ADVICE r4: a dead
+    serve_forever thread used to leave waiters hanging silently)."""
+    cfg, params = model
+    eng = InferenceEngine(params, cfg, slots=2, max_prompt_len=16,
+                          max_new_tokens=8)
+    boom = RuntimeError("device lost")
+
+    def exploding_step():
+        raise boom
+    eng.step = exploding_step
+    req = eng.submit([1, 2, 3])  # queued before the loop ever runs
+    eng.serve_forever()
+    assert req.done.wait(10)
+    assert req.error is boom and req.finish_reason == "error"
+    eng._thread.join(timeout=10)
+    with pytest.raises(RuntimeError, match="dead"):
+        eng.submit([4, 5])
+    with pytest.raises(RuntimeError, match="dead"):
+        eng.submit_stream([4, 5])
