@@ -18,6 +18,7 @@ reference keeps NCCL traffic out of its object store.
 
 from __future__ import annotations
 
+import itertools
 import os
 import subprocess
 import sys
@@ -164,6 +165,8 @@ class Head:
         self.task_events_dropped = 0
         # cluster-merged metrics: (name, tags_key) -> row dict
         self.metrics: Dict[tuple, dict] = {}
+        # auto-names for actors created by non-Python frontends
+        self._xlang_actor_seq = itertools.count()
         self._log_monitor = None
         # Durable control-plane WAL (reference: GCS Redis store client).
         self._persist: Optional[HeadStore] = None
@@ -1559,27 +1562,14 @@ class Head:
         # off the IO thread: submission blocks on lease grant + execution
         threading.Thread(target=run, daemon=True, name="xlang").start()
 
-    def _xlang_execute(self, req: dict):
-        op = req.get("op", "submit")
-        if op == "cluster":
-            with self._lock:
-                alive = [n for n in self.nodes.values() if n.alive]
-                totals: Dict[str, float] = {}
-                for n in alive:
-                    for k, v in n.resources.total.to_dict().items():
-                        totals[k] = totals.get(k, 0.0) + v
-                return {"nodes": len(alive), "resources": totals}
-        if op != "submit":
-            raise ValueError(f"unknown xlang op {op!r}")
+    def _xlang_resolve(self, target: str):
+        """'module:qualname' -> the python object, allowlist-checked."""
         import importlib
 
-        import ray_tpu
-
-        target = req["function"]
         mod_name, _, qual = target.partition(":")
         if not qual:
             raise ValueError(
-                f"function {target!r} must be 'module:qualname'")
+                f"target {target!r} must be 'module:qualname'")
         allowed = get_config().xlang_allowed_prefixes
         if allowed:
             def _matches(p: str) -> bool:
@@ -1594,12 +1584,53 @@ class Head:
         obj = importlib.import_module(mod_name)
         for part in qual.split("."):
             obj = getattr(obj, part)
-        rf = ray_tpu.remote(obj)
-        opts = req.get("options") or {}
-        if opts:
-            rf = rf.options(**opts)
-        ref = rf.remote(*req.get("args", []), **(req.get("kwargs") or {}))
-        return ray_tpu.get(ref, timeout=float(req.get("timeout_s", 300)))
+        return obj
+
+    def _xlang_execute(self, req: dict):
+        """Cross-language frontend ops (C++/Java clients; the raw-JSON
+        reply path of XLANG_CALL). Ref analog:
+        cpp/src/ray/runtime/task/task_submitter.h:26 — normal tasks AND
+        actor create/submit/kill from non-Python frontends."""
+        import ray_tpu
+
+        op = req.get("op", "submit")
+        timeout = float(req.get("timeout_s", 300))
+        if op == "cluster":
+            with self._lock:
+                alive = [n for n in self.nodes.values() if n.alive]
+                totals: Dict[str, float] = {}
+                for n in alive:
+                    for k, v in n.resources.total.to_dict().items():
+                        totals[k] = totals.get(k, 0.0) + v
+                return {"nodes": len(alive), "resources": totals}
+        if op == "submit":
+            rf = ray_tpu.remote(self._xlang_resolve(req["function"]))
+            opts = req.get("options") or {}
+            if opts:
+                rf = rf.options(**opts)
+            ref = rf.remote(*req.get("args", []),
+                            **(req.get("kwargs") or {}))
+            return ray_tpu.get(ref, timeout=timeout)
+        if op == "actor_create":
+            cls = ray_tpu.remote(self._xlang_resolve(req["class"]))
+            opts = dict(req.get("options") or {})
+            name = opts.pop("name", None) or \
+                f"xlang-actor-{next(self._xlang_actor_seq)}"
+            cls.options(name=name, **opts).remote(
+                *req.get("args", []), **(req.get("kwargs") or {}))
+            # the name registers at creation; subsequent actor_calls
+            # queue behind __init__ per actor task ordering
+            return {"actor": name}
+        if op == "actor_call":
+            handle = ray_tpu.get_actor(req["actor"])
+            method = getattr(handle, req["method"])
+            ref = method.remote(*req.get("args", []),
+                                **(req.get("kwargs") or {}))
+            return ray_tpu.get(ref, timeout=timeout)
+        if op == "actor_kill":
+            ray_tpu.kill(ray_tpu.get_actor(req["actor"]))
+            return {"killed": req["actor"]}
+        raise ValueError(f"unknown xlang op {op!r}")
 
     _HANDLERS = {
         P.REGISTER: _h_register,

@@ -40,16 +40,40 @@ public final class RayTpuClient implements AutoCloseable {
         this.out = new DataOutputStream(sock.getOutputStream());
     }
 
+    /** Send one XLANG_CALL request (JSON body) and block for the reply. */
+    public String call(String reqJson) throws IOException {
+        sendFrame(pickleCall(XLANG_CALL, 1,
+                             reqJson.getBytes(StandardCharsets.UTF_8)));
+        byte[] raw = readRawFrame();
+        return new String(raw, StandardCharsets.UTF_8);
+    }
+
     /** Submit module:qualname(argsJson...) and block for the JSON reply. */
     public String submit(String function, String argsJson, String optionsJson)
             throws IOException {
-        String req = "{\"op\":\"submit\",\"function\":\"" + function
+        return call("{\"op\":\"submit\",\"function\":\"" + function
                 + "\",\"args\":" + argsJson + ",\"options\":"
-                + optionsJson + "}";
-        sendFrame(pickleCall(XLANG_CALL, 1,
-                             req.getBytes(StandardCharsets.UTF_8)));
-        byte[] raw = readRawFrame();
-        return new String(raw, StandardCharsets.UTF_8);
+                + optionsJson + "}");
+    }
+
+    /** Create a named actor from module:Class; returns the JSON reply
+     *  whose result carries the registered actor name. */
+    public String actorCreate(String cls, String argsJson,
+                              String optionsJson) throws IOException {
+        return call("{\"op\":\"actor_create\",\"class\":\"" + cls
+                + "\",\"args\":" + argsJson + ",\"options\":"
+                + optionsJson + "}");
+    }
+
+    public String actorCall(String actor, String method, String argsJson)
+            throws IOException {
+        return call("{\"op\":\"actor_call\",\"actor\":\"" + actor
+                + "\",\"method\":\"" + method + "\",\"args\":"
+                + argsJson + "}");
+    }
+
+    public String actorKill(String actor) throws IOException {
+        return call("{\"op\":\"actor_kill\",\"actor\":\"" + actor + "\"}");
     }
 
     // (int, int, bytes) tuple, pickle protocol 3 — see task_client.cc
@@ -111,16 +135,53 @@ public final class RayTpuClient implements AutoCloseable {
         if (args.length < 2) {
             System.err.println(
                 "usage: RayTpuClient <host:port> <module:qualname> "
-                + "[json-args] [json-options]");
+                + "[json-args] [json-options]\n"
+                + "       RayTpuClient <host:port> actor-create "
+                + "<module:Class> [json-args] [json-options]\n"
+                + "       RayTpuClient <host:port> actor-call "
+                + "<actor> <method> [json-args]\n"
+                + "       RayTpuClient <host:port> actor-kill <actor>");
             System.exit(2);
         }
         String[] hp = args[0].replaceFirst("^tcp:", "").split(":");
         try (RayTpuClient client =
                  new RayTpuClient(hp[0], Integer.parseInt(hp[1]))) {
-            String reply = client.submit(
-                args[1],
-                args.length > 2 ? args[2] : "[]",
-                args.length > 3 ? args[3] : "{}");
+            String reply;
+            switch (args[1]) {
+                case "actor-create":
+                    if (args.length < 3) {
+                        System.err.println(
+                            "actor-create needs <module:Class>");
+                        System.exit(2);
+                    }
+                    reply = client.actorCreate(
+                        args[2],
+                        args.length > 3 ? args[3] : "[]",
+                        args.length > 4 ? args[4] : "{}");
+                    break;
+                case "actor-call":
+                    if (args.length < 4) {
+                        System.err.println(
+                            "actor-call needs <actor> <method>");
+                        System.exit(2);
+                    }
+                    reply = client.actorCall(
+                        args[2], args[3],
+                        args.length > 4 ? args[4] : "[]");
+                    break;
+                case "actor-kill":
+                    if (args.length < 3) {
+                        System.err.println("actor-kill needs <actor>");
+                        System.exit(2);
+                    }
+                    reply = client.actorKill(args[2]);
+                    break;
+                default:
+                    reply = client.submit(
+                        args[1],
+                        args.length > 2 ? args[2] : "[]",
+                        args.length > 3 ? args[3] : "{}");
+            }
             System.out.println(reply);
             System.exit(reply.contains("\"status\": \"ok\"")
                         || reply.contains("\"status\":\"ok\"") ? 0 : 1);

@@ -11,9 +11,15 @@
 // descriptor ("module:qualname"), the cross-language pattern of
 // python/ray/cross_language.py:15.
 //
-// Usage: task_client <addr> <module:qualname> [json-args] [json-opts]
-//                    [json-args-array] [json-options]
-// Prints the JSON reply's result to stdout; exit 0 iff status == "ok".
+// Usage:
+//   task_client <addr> <module:qualname> [json-args] [json-options]
+//   task_client <addr> actor-create <module:Class> [json-args] [json-opts]
+//   task_client <addr> actor-call <actor-name> <method> [json-args]
+//   task_client <addr> actor-kill <actor-name>
+// The actor subcommands are the C++ actor API (ref analog:
+// cpp/src/ray/runtime/task/task_submitter.h:26 actor creation/submission
+// paths): create prints the registered actor name, call prints the
+// method result, kill tears the actor down. Exit 0 iff status == "ok".
 //
 // Build: g++ -O2 -o task_client task_client.cc   (native/build.py)
 
@@ -214,11 +220,37 @@ int main(int argc, char** argv) {
     return 2;
   }
 
-  const std::string args = argc > 3 ? argv[3] : "[]";
-  const std::string options = argc > 4 ? argv[4] : "{}";
-  std::string req = std::string("{\"op\":\"submit\",\"function\":\"") +
-                    argv[2] + "\",\"args\":" + args +
-                    ",\"options\":" + options + "}";
+  const std::string cmd = argv[2];
+  std::string req;
+  if (cmd == "actor-create") {
+    if (argc < 4) {
+      fprintf(stderr, "actor-create needs <module:Class>\n");
+      return 2;
+    }
+    req = std::string("{\"op\":\"actor_create\",\"class\":\"") + argv[3] +
+          "\",\"args\":" + (argc > 4 ? argv[4] : "[]") +
+          ",\"options\":" + (argc > 5 ? argv[5] : "{}") + "}";
+  } else if (cmd == "actor-call") {
+    if (argc < 5) {
+      fprintf(stderr, "actor-call needs <actor-name> <method>\n");
+      return 2;
+    }
+    req = std::string("{\"op\":\"actor_call\",\"actor\":\"") + argv[3] +
+          "\",\"method\":\"" + argv[4] +
+          "\",\"args\":" + (argc > 5 ? argv[5] : "[]") + "}";
+  } else if (cmd == "actor-kill") {
+    if (argc < 4) {
+      fprintf(stderr, "actor-kill needs <actor-name>\n");
+      return 2;
+    }
+    req = std::string("{\"op\":\"actor_kill\",\"actor\":\"") + argv[3] +
+          "\"}";
+  } else {
+    // default: normal-task submission by function descriptor
+    req = std::string("{\"op\":\"submit\",\"function\":\"") + cmd +
+          "\",\"args\":" + (argc > 3 ? argv[3] : "[]") +
+          ",\"options\":" + (argc > 4 ? argv[4] : "{}") + "}";
+  }
   const int rid = 1;
   if (!SendFrame(fd, PickleCall(kXlangCall, rid, req))) {
     fprintf(stderr, "send failed\n");

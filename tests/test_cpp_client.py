@@ -145,3 +145,50 @@ class TestCppTaskSubmission:
             assert "error" in out.stderr.lower()
         finally:
             ray_tpu.shutdown()
+
+    def test_actor_create_call_kill_roundtrip(self, task_client):
+        """C++ actor API over the framed protocol (ref:
+        cpp/src/ray/runtime/task/task_submitter.h:26 actor paths):
+        create a named actor, observe state persist across calls,
+        kill it, then verify calls fail."""
+        import ray_tpu
+
+        info = ray_tpu.init(num_cpus=2, num_tpus=0)
+        try:
+            addr = info.head.enable_tcp(host="127.0.0.1",
+                                        advertise_ip="127.0.0.1")
+            out = _run(task_client, addr, "actor-create",
+                       "xlang_funcs:Counter", "[10]",
+                       '{"name": "cpp-counter"}')
+            assert out.returncode == 0, out.stderr
+            assert "cpp-counter" in out.stdout
+            out = _run(task_client, addr, "actor-call", "cpp-counter",
+                       "inc", "[5]")
+            assert out.returncode == 0, out.stderr
+            assert out.stdout.strip() == "15"
+            # state persists across calls (it's one actor, not tasks)
+            out = _run(task_client, addr, "actor-call", "cpp-counter",
+                       "value")
+            assert out.returncode == 0, out.stderr
+            assert out.stdout.strip() == "15"
+            out = _run(task_client, addr, "actor-kill", "cpp-counter")
+            assert out.returncode == 0, out.stderr
+            out = _run(task_client, addr, "actor-call", "cpp-counter",
+                       "value")
+            assert out.returncode == 1
+        finally:
+            ray_tpu.shutdown()
+
+    def test_actor_auto_name_assigned(self, task_client):
+        import ray_tpu
+
+        info = ray_tpu.init(num_cpus=2, num_tpus=0)
+        try:
+            addr = info.head.enable_tcp(host="127.0.0.1",
+                                        advertise_ip="127.0.0.1")
+            out = _run(task_client, addr, "actor-create",
+                       "xlang_funcs:Counter")
+            assert out.returncode == 0, out.stderr
+            assert "xlang-actor-" in out.stdout
+        finally:
+            ray_tpu.shutdown()

@@ -15,3 +15,17 @@ def pid():
     import os
 
     return os.getpid()
+
+
+class Counter:
+    """Actor class the C++ client creates/calls/kills by descriptor."""
+
+    def __init__(self, start=0):
+        self.n = int(start)
+
+    def inc(self, by=1):
+        self.n += int(by)
+        return self.n
+
+    def value(self):
+        return self.n
