@@ -357,11 +357,14 @@ def main():
         if status == "failed" and name == "llama3-1b":
             # one retry with backoff — r4's UNAVAILABLE was transient-class
             time.sleep(10)
-            if run_child(name)[0] == "ok":
+            retry_status, _ = run_child(name)
+            if retry_status == "ok":
                 flagship_ok = True
-            # either way keep going: the startswith guard skips the 8b
-            # ladder when the flagship failed, falling through to the
-            # gpt2-small step-down so the artifact still gets a number
+            elif retry_status in ("timeout", "no_budget"):
+                break  # wedged/banked-out backend: stop touching it
+            # else fall through: the startswith guard skips the 8b ladder
+            # when the flagship failed, and the gpt2-small step-down still
+            # gets the artifact a number
             continue
     emit_and_exit()
 

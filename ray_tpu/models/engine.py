@@ -286,6 +286,13 @@ class InferenceEngine:
         self._rid = itertools.count()
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._slot_req: List[Optional[_Request]] = [None] * self.slots
+        # planned-occupancy scheduling: _slot_left[s] is how many tokens
+        # the resident request is still OWED BY DISPATCH (not by fetch).
+        # Residency is length-bounded and known at submit time, so
+        # admission decisions never wait for a device->host fetch — the
+        # fetch is pure result delivery. eos can only shorten a plan; it
+        # is reclaimed when a fetch reveals it.
+        self._slot_left: List[int] = [0] * self.slots
         # the token chain lives ON DEVICE: chunk N+1's inputs are chunk
         # N's last samples (or a prefill's first sample, merged in with
         # .at[slot].set) — the host never syncs to keep the chain going
@@ -386,8 +393,9 @@ class InferenceEngine:
         self.stats["prefills"] += 1
 
     def _emit_to(self, req: _Request, slot: int, tok: int):
-        """Record one generated token; frees the slot when the request
-        just finished (only if the slot still belongs to it)."""
+        """Record one generated token; on an eos finish, reclaim the
+        slot's remaining planned occupancy (the plan is length-based and
+        eos can only shorten it)."""
         req.emit(tok)
         self.stats["tokens_out"] += 1
         reason = None
@@ -398,6 +406,7 @@ class InferenceEngine:
         if reason is not None:
             if self._slot_req[slot] is req:
                 self._slot_req[slot] = None
+                self._slot_left[slot] = 0
             self.stats["requests_done"] += 1
             req.finish(reason)
 
@@ -406,43 +415,66 @@ class InferenceEngine:
         with self._lock:
             return self._step_locked()
 
+    def _pow2_floor(self, x: int) -> int:
+        return 1 << (max(1, min(x, self.decode_chunk)).bit_length() - 1)
+
     def _step_locked(self) -> bool:
-        # 1) admission: fill every free slot that has a queued request
-        #    (async prefill dispatches, chained on the device queue)
+        # 1) admission: a slot whose planned occupancy ran out is free —
+        #    no fetch needed to know it (delivery of its resident's
+        #    tokens rides the already-recorded snapshots). Prefills are
+        #    async dispatches chained on the device queue.
         admitted = set()
         for slot in range(self.slots):
-            if self._slot_req[slot] is not None:
+            if self._slot_left[slot] > 0:
                 continue
+            if self._slot_req[slot] is not None:
+                # planned release: dispatching for it is complete
+                self._slot_req[slot] = None
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
-                break
+                continue
             try:
                 self._admit(req, slot)
+                # the plan includes the prefill-sampled first token; it
+                # reaches the host in the next chunk's echo column
+                self._slot_left[slot] = req.max_new_tokens
                 admitted.add(slot)
             except BaseException as e:  # surface to the waiter, keep going
                 req.error = e
                 req.finish("error")
                 continue
-        # 2) dispatch the next decode chunk (async) for occupied slots.
-        #    Slots that the not-yet-fetched previous chunk finished are
-        #    still marked occupied here — they decode one junk chunk
-        #    (bounded waste, ignored at fetch time via the snapshot).
-        snapshot = [(slot, req, 0 if slot in admitted else 1)
-                    for slot, req in enumerate(self._slot_req)
-                    if req is not None]
+        # 2) dispatch one decode chunk (async) for every slot with planned
+        #    work. Width adapts: under admission pressure the chunk is cut
+        #    at the earliest planned release (power-of-two widths bound
+        #    the compile count); otherwise the full decode_chunk runs.
+        active_slots = [s for s in range(self.slots)
+                        if self._slot_left[s] > 0]
         dispatched = False
-        if snapshot:
+        if active_slots:
+            if self._queue.qsize() > 0:
+                need = min(self._slot_left[s] - (1 if s in admitted else 0)
+                           for s in active_slots)
+                width = self._pow2_floor(max(1, need))
+            else:
+                width = self.decode_chunk
+            snapshot = []
+            for slot in active_slots:
+                req = self._slot_req[slot]
+                new = slot in admitted
+                take = min(self._slot_left[slot], width + (1 if new else 0))
+                snapshot.append((slot, req, 0 if new else 1, take))
+                self._slot_left[slot] = max(
+                    0, self._slot_left[slot] - (width + 1 if new else width))
             active = np.zeros(self.slots, bool)
-            for slot, _, _ in snapshot:
-                active[slot] = True
+            active[active_slots] = True
             self.cache, toks = decode_slots(
                 self.params, self.cache, self._next_tok_dev,
                 jnp.asarray(active), self._next_rng(), self.cfg,
                 self.greedy, self.temperature, self.eos_id,
-                steps=self.decode_chunk)
+                steps=width)
             self._next_tok_dev = toks[:, -1]
-            self.stats["decode_steps"] += self.decode_chunk
+            self.stats["decode_steps"] += width
             self._inflight.append((toks, snapshot))
             dispatched = True
         # 3) flush: one device-side concat + ONE transfer for every
@@ -452,19 +484,24 @@ class InferenceEngine:
         processed = False
         if self._inflight and (len(self._inflight) >= self.fetch_every
                                or not dispatched):
-            parts = [t for t, _ in self._inflight]
             pending, self._inflight = self._inflight, []
+            # pad every chunk to one uniform width before the device-side
+            # concat: adaptive widths would otherwise make the concat's
+            # shape signature (and its compiled program) vary per width
+            # combination
+            W = self.decode_chunk + 1
+            parts = [t if t.shape[1] == W
+                     else jnp.pad(t, ((0, 0), (0, W - t.shape[1])))
+                     for t, _ in pending]
             big = np.asarray(parts[0] if len(parts) == 1
                              else jnp.concatenate(parts, axis=1))
-            col = 0
-            for toks_dev, snap in pending:
+            for i, (toks_dev, snap) in enumerate(pending):
                 width = toks_dev.shape[1]
-                seg = big[:, col:col + width]
-                col += width
-                for slot, req, from_col in snap:
+                seg = big[:, i * W:i * W + width]
+                for slot, req, from_col, take in snap:
                     if req.done.is_set():
                         continue  # finished in an earlier chunk
-                    for t in range(from_col, width):
+                    for t in range(from_col, from_col + take):
                         self._emit_to(req, slot, int(seg[slot, t]))
                         if req.done.is_set():
                             break  # rest of the row is frozen eos/junk
@@ -510,6 +547,7 @@ class InferenceEngine:
         """Mark the engine dead and fail every known request."""
         failed = [r for r in self._slot_req if r is not None]
         self._slot_req = [None] * self.slots
+        self._slot_left = [0] * self.slots
         with self._death_lock:
             # after this block no submit() can enqueue: _fatal is visible
             # to every subsequent check, and the queue is drained
@@ -520,7 +558,7 @@ class InferenceEngine:
                 except queue.Empty:
                     break
         for _, snap in self._inflight:
-            failed.extend(req for _, req, _ in snap)
+            failed.extend(req for _, req, _, _ in snap)
         self._inflight = []
         for req in failed:
             if not req.done.is_set():

@@ -280,11 +280,19 @@ def test_step_loop_death_fails_all_waiters(model):
 
     def exploding_step():
         raise boom
+    # put a real undelivered chunk in flight so death handling must fail
+    # in-flight snapshots too, not just the queue
+    eng.fetch_every = 4
+    inflight_req = eng.submit([9, 9])
+    eng._step_locked()  # admit + dispatch one chunk, no fetch yet
+    assert eng._inflight, "precondition: an undelivered chunk exists"
     eng.step = exploding_step
     req = eng.submit([1, 2, 3])  # queued before the loop ever runs
     eng.serve_forever()
     assert req.done.wait(10)
     assert req.error is boom and req.finish_reason == "error"
+    assert inflight_req.done.wait(10)
+    assert inflight_req.error is boom
     eng._thread.join(timeout=10)
     with pytest.raises(RuntimeError, match="dead"):
         eng.submit([4, 5])
