@@ -24,7 +24,39 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: model-heavy tests recompile identical
+# programs on every run otherwise (the full suite exceeded 40 min on one
+# core in the round-4 review). First run pays the compiles and fills the
+# cache; reruns hit it. (ref analog: the reference pins compiled-artifact
+# caches in CI images rather than rebuilding per run)
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+try:  # XLA:CPU needs its sub-caches opted in (newer jax only)
+    jax.config.update("jax_persistent_cache_enable_xla_caches",
+                      "all")
+except Exception:
+    pass
+
 import pytest  # noqa: E402
+
+# Module-level tier assignment: these files are dominated by JAX model
+# compiles (tens of seconds each on one core). Everything else is the
+# fast tier. Keep in sync with pytest.ini's marker docs.
+SLOW_MODULES = {
+    "test_models", "test_encoder", "test_generate", "test_engine",
+    "test_parallel", "test_train", "test_tune", "test_ops",
+    "test_rllib", "test_rllib_breadth", "test_rllib_sac",
+    "test_serve_depth", "test_data_breadth",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = getattr(item.module, "__name__", "")
+        if mod in SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
 
 
 @pytest.fixture
