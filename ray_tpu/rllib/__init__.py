@@ -12,8 +12,12 @@ Algorithms are Tune Trainables, so ``Tuner(PPO, param_space=...)`` works.
         print(algo.train()["episode_reward_mean"])
 """
 
+from .a2c import A2C, A2CConfig, A2CLearner
 from .algorithm import Algorithm, AlgorithmConfig
+from .apex_dqn import ApexDQN, ApexDQNConfig, ReplayShard
 from .appo import APPO, APPOConfig, APPOLearner
+from .connectors import (ClipAction, ClipObs, Connector, ConnectorPipeline,
+                         FlattenObs, NormalizeObs, UnsquashAction)
 from .bandits import BanditConfig, BanditLinTS, BanditLinUCB
 from .dqn import DQN, DQNConfig, DQNLearner
 from .env import (BreakoutMini, CartPole, ContextualBandit, Env, Pendulum,
@@ -47,4 +51,7 @@ __all__ = [
     "BanditLinUCB", "BanditLinTS", "BanditConfig", "BC", "BCConfig",
     "CQL", "CQLConfig", "collect_dataset", "load_batches", "save_batches",
     "BreakoutMini", "ContextualBandit",
+    "A2C", "A2CConfig", "A2CLearner", "ApexDQN", "ApexDQNConfig",
+    "ReplayShard", "Connector", "ConnectorPipeline", "FlattenObs",
+    "NormalizeObs", "ClipObs", "ClipAction", "UnsquashAction",
 ]

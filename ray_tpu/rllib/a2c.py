@@ -1,0 +1,141 @@
+"""A2C: synchronous advantage actor-critic.
+
+Ref analog: rllib/algorithms/a2c/a2c.py (A2CConfig, training_step —
+sample synchronously from all workers, ONE gradient step on the joint
+batch, broadcast). The TPU-first shape mirrors PPO's learner but with
+the vanilla policy-gradient loss (no ratio clipping, no SGD epochs):
+the whole update is one jitted XLA program; microbatching is available
+via ``microbatch_size`` (the reference's A2C grad-accumulation knob).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+
+from . import sample_batch as SB
+from .algorithm import Algorithm, AlgorithmConfig
+from .models import entropy_of, forward, init_actor_critic, logp_of
+from .sample_batch import SampleBatch, concat_samples
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or A2C)
+        self.lr = 1e-3
+        self.microbatch_size = 0  # 0 = single step on the whole batch
+
+
+class A2CLearner:
+    """One jitted actor-critic gradient step (loss = -logp * adv +
+    vf_coeff * vf_mse - entropy_coeff * entropy)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, *, lr: float,
+                 vf_coeff: float, entropy_coeff: float, grad_clip: float,
+                 hiddens=(64, 64), seed: int = 0):
+        self.params = init_actor_critic(jax.random.key(seed), obs_dim,
+                                        num_actions, hiddens)
+        self.tx = optax.chain(optax.clip_by_global_norm(grad_clip),
+                              optax.adam(lr))
+        self.opt_state = self.tx.init(self.params)
+
+        def loss_fn(params, batch):
+            logits, values = forward(params, batch[SB.OBS])
+            logp = logp_of(logits, batch[SB.ACTIONS])
+            adv = batch[SB.ADVANTAGES]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            pi_loss = -(logp * adv).mean()
+            vf_loss = jnp.mean((values - batch[SB.VALUE_TARGETS]) ** 2)
+            ent = entropy_of(logits).mean()
+            total = pi_loss + vf_coeff * vf_loss - entropy_coeff * ent
+            return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": ent}
+
+        @jax.jit
+        def train_step(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        @jax.jit
+        def grad_step(params, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            metrics["total_loss"] = loss
+            return grads, metrics
+
+        @jax.jit
+        def apply_grads_step(params, opt_state, grads):
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._train_step = train_step
+        self._grad_step = grad_step
+        self._apply_grads_step = apply_grads_step
+
+    def update(self, batch: SampleBatch, *, microbatch_size: int = 0,
+               **_) -> dict:
+        metrics = {}
+        if microbatch_size and batch.count > microbatch_size:
+            for mb in batch.minibatches(microbatch_size):
+                self.params, self.opt_state, metrics = self._train_step(
+                    self.params, self.opt_state,
+                    {k: jnp.asarray(v) for k, v in mb.items()})
+        else:
+            self.params, self.opt_state, metrics = self._train_step(
+                self.params, self.opt_state,
+                {k: jnp.asarray(v) for k, v in batch.items()})
+        return {k: float(v) for k, v in metrics.items()}
+
+    # distributed (grad-averaging) path — LearnerGroup remote learners
+    def compute_grads(self, batch: SampleBatch):
+        grads, metrics = self._grad_step(
+            self.params, {k: jnp.asarray(v) for k, v in batch.items()})
+        return ({k: np.asarray(v) for k, v in grads.items()},
+                {k: float(v) for k, v in metrics.items()})
+
+    def apply_grads(self, grads: Dict[str, np.ndarray]):
+        self.params, self.opt_state = self._apply_grads_step(
+            self.params, self.opt_state,
+            {k: jnp.asarray(v) for k, v in grads.items()})
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in self.params.items()}
+
+    def set_weights(self, weights: Dict[str, np.ndarray]):
+        self.params = {k: jnp.asarray(v) for k, v in weights.items()}
+
+
+class A2C(Algorithm):
+    _config_cls = A2CConfig
+
+    def _make_learner_factory(self, cfg, obs_dim, num_actions):
+        def make():
+            return A2CLearner(obs_dim, num_actions, lr=cfg.lr,
+                              vf_coeff=cfg.vf_coeff,
+                              entropy_coeff=cfg.entropy_coeff,
+                              grad_clip=cfg.grad_clip,
+                              hiddens=cfg.model_hiddens, seed=cfg.seed)
+
+        return make
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        batches = ray_tpu.get(
+            [w.sample.remote() for w in self.workers], timeout=600)
+        batch = concat_samples(batches)
+        self._num_env_steps += batch.count
+        metrics = self.learners.update(
+            batch, microbatch_size=cfg.microbatch_size)
+        self._sync_weights()
+        metrics["env_steps_this_iter"] = batch.count
+        return metrics

@@ -43,6 +43,10 @@ class AlgorithmConfig:
         self.entropy_coeff = 0.01
         self.vf_coeff = 0.5
         self.grad_clip = 0.5
+        # zero-arg factory -> ConnectorPipeline; every rollout worker
+        # builds its own stateful instance (ref:
+        # connectors/agent/pipeline.py)
+        self.connectors = None
 
     # ---- fluent sections (each returns self, ref: algorithm_config.py) ----
 
@@ -53,14 +57,16 @@ class AlgorithmConfig:
 
     def rollouts(self, *, num_rollout_workers: Optional[int] = None,
                  num_envs_per_worker: Optional[int] = None,
-                 rollout_fragment_length: Optional[int] = None
-                 ) -> "AlgorithmConfig":
+                 rollout_fragment_length: Optional[int] = None,
+                 connectors=None) -> "AlgorithmConfig":
         if num_rollout_workers is not None:
             self.num_rollout_workers = num_rollout_workers
         if num_envs_per_worker is not None:
             self.num_envs_per_worker = num_envs_per_worker
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if connectors is not None:
+            self.connectors = connectors
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":
@@ -128,7 +134,8 @@ class Algorithm(Trainable):
             worker_cls.options(num_cpus=1).remote(
                 cfg.env, cfg.num_envs_per_worker,
                 cfg.rollout_fragment_length, cfg.gamma, cfg.lambda_,
-                cfg.model_hiddens, seed=cfg.seed + i, worker_idx=i)
+                cfg.model_hiddens, seed=cfg.seed + i, worker_idx=i,
+                connectors=cfg.connectors)
             for i in range(cfg.num_rollout_workers)
         ]
         probe = self._probe_env = self._make_probe_env()
@@ -136,9 +143,15 @@ class Algorithm(Trainable):
         # their action count — the factory knows which it asked for
         act_dim = (probe.action_dim if getattr(probe, "continuous", False)
                    else probe.num_actions)
+        obs_dim = probe.observation_dim
+        if cfg.connectors is not None:
+            # the learner's net must be sized for CONNECTED observations
+            # (factory or instance, same contract as RolloutWorker)
+            pipe = cfg.connectors() if callable(cfg.connectors) \
+                else cfg.connectors
+            obs_dim = pipe.observation_dim(obs_dim)
         self.learners = LearnerGroup(
-            self._make_learner_factory(cfg, probe.observation_dim,
-                                       act_dim),
+            self._make_learner_factory(cfg, obs_dim, act_dim),
             num_learners=cfg.num_learners)
         self._episode_returns: collections.deque = collections.deque(
             maxlen=50)

@@ -13,13 +13,15 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from . import sample_batch as SB
+from .connectors import ConnectorPipeline
 from .env import VectorEnv
 from .policy import JaxPolicy
 from .sample_batch import SampleBatch, compute_gae
 
 
 def _collect_transitions(vec: VectorEnv, rollout_len: int, select_actions,
-                         act_shape: tuple, act_dtype) -> SampleBatch:
+                         act_shape: tuple, act_dtype,
+                         conn: ConnectorPipeline) -> SampleBatch:
     """Shared (s, a, r, s', terminated) collection loop for the
     off-policy paths (DQN's epsilon-greedy and SAC's squashed-Gaussian
     workers differ only in action selection).
@@ -29,20 +31,27 @@ def _collect_transitions(vec: VectorEnv, rollout_len: int, select_actions,
     bootstrap, or the Bellman target regresses boundary transitions
     toward r alone (the classic timeout-bootstrap bug).
     """
-    T, N, D = rollout_len, vec.num_envs, vec.observation_dim
+    T, N = rollout_len, vec.num_envs
+    D = conn.observation_dim(vec.observation_dim)
     obs_buf = np.zeros((T, N, D), np.float32)
     next_buf = np.zeros((T, N, D), np.float32)
     act_buf = np.zeros((T, N) + act_shape, act_dtype)
     rew_buf = np.zeros((T, N), np.float32)
     done_buf = np.zeros((T, N), np.bool_)
 
-    obs = vec.obs
+    obs = conn.transform_obs(vec.obs)
     for t in range(T):
         actions = select_actions(obs)
         obs_buf[t] = obs
         act_buf[t] = actions
-        obs, rewards, dones = vec.step(actions)
-        next_buf[t] = vec.final_obs
+        _, rewards, dones = vec.step(conn.transform_action(actions))
+        # s' is an auxiliary view of (mostly) the same observations the
+        # next iteration records — transform it with stats frozen so
+        # running normalizers count each observation once
+        conn.set_frozen(True)
+        next_buf[t] = conn.transform_obs(vec.final_obs)
+        conn.set_frozen(False)
+        obs = conn.transform_obs(vec.obs)
         rew_buf[t] = rewards
         done_buf[t] = dones & ~vec.truncateds
 
@@ -59,9 +68,15 @@ def _collect_transitions(vec: VectorEnv, rollout_len: int, select_actions,
 class RolloutWorker:
     def __init__(self, env_creator, num_envs: int, rollout_len: int,
                  gamma: float, lam: float, hiddens=(64, 64),
-                 seed: int = 0, worker_idx: int = 0):
+                 seed: int = 0, worker_idx: int = 0, connectors=None):
         self.vec = VectorEnv(env_creator, num_envs, seed=seed * 1000 + 17)
-        self.policy = JaxPolicy(self.vec.observation_dim,
+        # env <-> policy coupling goes through the connector pipeline
+        # (ref: connectors/agent/pipeline.py); a factory arrives here so
+        # every worker owns its own (stateful) instance
+        self.conn = connectors() if callable(connectors) else \
+            (connectors or ConnectorPipeline())
+        self.obs_dim = self.conn.observation_dim(self.vec.observation_dim)
+        self.policy = JaxPolicy(self.obs_dim,
                                 self.vec.num_actions, hiddens,
                                 seed=seed)
         self.rollout_len = rollout_len
@@ -73,7 +88,7 @@ class RolloutWorker:
     def sample(self) -> SampleBatch:
         """Collect one rollout of [T, N] and flatten to [T*N] with GAE."""
         T, N = self.rollout_len, self.vec.num_envs
-        obs_buf = np.zeros((T, N, self.vec.observation_dim), np.float32)
+        obs_buf = np.zeros((T, N, self.obs_dim), np.float32)
         act_buf = np.zeros((T, N), np.int64)
         rew_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), np.bool_)
@@ -81,7 +96,7 @@ class RolloutWorker:
         vf_buf = np.zeros((T, N), np.float32)
         logits_buf = np.zeros((T, N, self.vec.num_actions), np.float32)
 
-        obs = self.vec.obs
+        obs = self.conn.transform_obs(self.vec.obs)
         for t in range(T):
             actions, logp, vf, logits = self.policy.compute_actions(obs)
             obs_buf[t] = obs
@@ -89,7 +104,9 @@ class RolloutWorker:
             logp_buf[t] = logp
             vf_buf[t] = vf
             logits_buf[t] = logits
-            obs, rewards, dones = self.vec.step(actions)
+            _, rewards, dones = self.vec.step(
+                self.conn.transform_action(actions))
+            obs = self.conn.transform_obs(self.vec.obs)
             rew_buf[t] = rewards
             done_buf[t] = dones
 
@@ -112,19 +129,21 @@ class RolloutWorker:
     def sample_time_major(self) -> SampleBatch:
         """[T, N]-shaped batch (IMPALA/V-trace needs the time axis)."""
         T, N = self.rollout_len, self.vec.num_envs
-        obs_buf = np.zeros((T, N, self.vec.observation_dim), np.float32)
+        obs_buf = np.zeros((T, N, self.obs_dim), np.float32)
         act_buf = np.zeros((T, N), np.int64)
         rew_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), np.bool_)
         logp_buf = np.zeros((T, N), np.float32)
 
-        obs = self.vec.obs
+        obs = self.conn.transform_obs(self.vec.obs)
         for t in range(T):
             actions, logp, _, _ = self.policy.compute_actions(obs)
             obs_buf[t] = obs
             act_buf[t] = actions
             logp_buf[t] = logp
-            obs, rewards, dones = self.vec.step(actions)
+            _, rewards, dones = self.vec.step(
+                self.conn.transform_action(actions))
+            obs = self.conn.transform_obs(self.vec.obs)
             rew_buf[t] = rewards
             done_buf[t] = dones
 
@@ -157,7 +176,7 @@ class RolloutWorker:
             return actions
 
         return _collect_transitions(self.vec, self.rollout_len, select,
-                                    (), np.int64)
+                                    (), np.int64, self.conn)
 
     # ---- weight sync / metrics ----
 
@@ -187,15 +206,18 @@ class ContinuousRolloutWorker:
 
     def __init__(self, env_creator, num_envs: int, rollout_len: int,
                  gamma: float, lam: float, hiddens=(64, 64),
-                 seed: int = 0, worker_idx: int = 0):
+                 seed: int = 0, worker_idx: int = 0, connectors=None):
         from .policy import SquashedGaussianPolicy
 
         self.vec = VectorEnv(env_creator, num_envs, seed=seed * 1000 + 17)
         assert self.vec.continuous, "use RolloutWorker for discrete envs"
+        self.conn = connectors() if callable(connectors) else \
+            (connectors or ConnectorPipeline())
         self._env_creator = env_creator
         env0 = self.vec.envs[0]
         self.policy = SquashedGaussianPolicy(
-            self.vec.observation_dim, self.vec.action_dim,
+            self.conn.observation_dim(self.vec.observation_dim),
+            self.vec.action_dim,
             action_scale=(env0.action_high - env0.action_low) / 2.0,
             action_shift=(env0.action_high + env0.action_low) / 2.0,
             hiddens=hiddens, seed=seed)
@@ -223,7 +245,7 @@ class ContinuousRolloutWorker:
             return actions
 
         return _collect_transitions(self.vec, self.rollout_len, select,
-                                    (A,), np.float32)
+                                    (A,), np.float32, self.conn)
 
     def evaluate(self, num_episodes: int = 5, seed: int = 0) -> dict:
         """Deterministic (mean-action) eval on a fresh env from the SAME
@@ -233,14 +255,20 @@ class ContinuousRolloutWorker:
 
         env = make_env(self._env_creator)
         returns = []
-        for ep in range(num_episodes):
-            obs = env.reset(seed=10_000 + seed * 100 + ep)
-            total, done = 0.0, False
-            while not done:
-                a, _ = self.policy.compute_actions(obs[None], explore=False)
-                obs, r, done, _ = env.step(a[0])
-                total += r
-            returns.append(total)
+        self.conn.set_frozen(True)  # eval must not pollute running stats
+        try:
+            for ep in range(num_episodes):
+                obs = env.reset(seed=10_000 + seed * 100 + ep)
+                total, done = 0.0, False
+                while not done:
+                    pobs = self.conn.transform_obs(obs[None])
+                    a, _ = self.policy.compute_actions(pobs, explore=False)
+                    a = self.conn.transform_action(a)
+                    obs, r, done, _ = env.step(a[0])
+                    total += r
+                returns.append(total)
+        finally:
+            self.conn.set_frozen(False)
         return {"mean_return": float(np.mean(returns)), "returns": returns}
 
     def set_weights(self, weights: Dict[str, np.ndarray]):
