@@ -183,6 +183,58 @@ def bench_cohort(cfg, params, *, slots, max_prompt, max_new,
             **_percentiles(lat)}
 
 
+def bench_proxy(clients: int, duration_s: float) -> dict:
+    """Proxy-level RPS/latency on a trivial deployment (measures the
+    asyncio ingress + router + replica hop, NOT model compute; ref:
+    the reference's serve microbenchmarks hit a noop deployment the
+    same way). Keep-alive HTTP/1.1 connections, closed loop."""
+    import http.client
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4, num_tpus=0, ignore_reinit_error=True)
+
+    @serve.deployment(max_concurrent_queries=64)
+    def noop(payload):
+        return payload
+
+    serve.run(noop.bind(), name="proxybench", route_prefix="/noop")
+    port = serve.start()
+
+    lat, lock = [], threading.Lock()
+    stop_at = time.perf_counter() + duration_s
+
+    def client():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        body = json.dumps({"k": 1})
+        try:
+            while time.perf_counter() < stop_at:
+                t0 = time.perf_counter()
+                conn.request("POST", "/noop", body=body)
+                resp = conn.getresponse()
+                resp.read()
+                dt = time.perf_counter() - t0
+                if resp.status == 200:
+                    with lock:
+                        lat.append(dt)
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s * 2 + 60)
+    wall = time.perf_counter() - t0
+    serve.shutdown()
+    return {"deployment": "noop", "clients": clients,
+            "requests": len(lat), "rps": round(len(lat) / wall, 1),
+            **_percentiles(lat)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="llama3-1b")
@@ -194,9 +246,25 @@ def main():
     ap.add_argument("--out", default="SERVE_BENCH_r5.json")
     ap.add_argument("--decode-chunk", type=int, default=16)
     ap.add_argument("--fetch-every", type=int, default=4)
+    ap.add_argument("--proxy-only", action="store_true",
+                    help="measure the HTTP ingress only (no model)")
+    ap.add_argument("--proxy-clients", type=int, default=16)
+    ap.add_argument("--proxy-duration", type=float, default=15.0)
     ap.add_argument("--skip-cohort", action="store_true",
                     help="iterate on the continuous engine only")
     args = ap.parse_args()
+
+    # proxy-level section first: it needs no accelerator, so the
+    # artifact gets ingress numbers even when the model backend is down
+    proxy = bench_proxy(args.proxy_clients, args.proxy_duration)
+    print(json.dumps({"proxy": proxy}), file=sys.stderr)
+    if args.proxy_only:
+        result = {"benchmark": "llm_serving_continuous_batching",
+                  "proxy": proxy}
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+        print(json.dumps(result))
+        return
 
     import jax
 
@@ -234,6 +302,7 @@ def main():
              f"{args.max_prompt}]; new_tokens ~ 80% "
              f"U[{max(2, args.max_new // 16)}, {max(4, args.max_new // 4)}]"
              f" + 20% U[{args.max_new // 2}, {args.max_new}]"),
+        "proxy": proxy,
         "continuous": cont,
         "cohort": coh,
         # both ratios are continuous/cohort: tokens >1 and p99 <1 mean

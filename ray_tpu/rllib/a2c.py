@@ -84,16 +84,28 @@ class A2CLearner:
 
     def update(self, batch: SampleBatch, *, microbatch_size: int = 0,
                **_) -> dict:
-        metrics = {}
         if microbatch_size and batch.count > microbatch_size:
+            # grad ACCUMULATION (the reference's A2C microbatch knob):
+            # average microbatch grads, then ONE optimizer step (adv
+            # normalization stays per-microbatch, as in the reference)
+            acc, metric_sums, n = None, {}, 0
             for mb in batch.minibatches(microbatch_size):
-                self.params, self.opt_state, metrics = self._train_step(
-                    self.params, self.opt_state,
-                    {k: jnp.asarray(v) for k, v in mb.items()})
-        else:
-            self.params, self.opt_state, metrics = self._train_step(
+                grads, metrics = self._grad_step(
+                    self.params, {k: jnp.asarray(v)
+                                  for k, v in mb.items()})
+                acc = grads if acc is None else jax.tree.map(
+                    jnp.add, acc, grads)
+                for k, v in metrics.items():
+                    metric_sums[k] = metric_sums.get(k, 0.0) + float(v)
+                n += 1
+            self.params, self.opt_state = self._apply_grads_step(
                 self.params, self.opt_state,
-                {k: jnp.asarray(v) for k, v in batch.items()})
+                jax.tree.map(lambda g: g / n, acc))
+            return {k: v / n for k, v in metric_sums.items()}
+        metrics = {}
+        self.params, self.opt_state, metrics = self._train_step(
+            self.params, self.opt_state,
+            {k: jnp.asarray(v) for k, v in batch.items()})
         return {k: float(v) for k, v in metrics.items()}
 
     # distributed (grad-averaging) path — LearnerGroup remote learners

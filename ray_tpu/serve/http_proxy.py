@@ -1,116 +1,304 @@
-"""HTTP ingress proxy: routes HTTP requests to application ingress handles.
+"""HTTP ingress proxy: asyncio event loop routing requests to app handles.
 
-Ref analog: python/ray/serve/_private/http_proxy.py:661 (HTTPProxyActor,
-uvicorn/ASGI). Re-design: a threaded stdlib HTTP server inside a plain
-actor — no ASGI layer; JSON bodies map to handle args, results map back to
-JSON. Routes come from the controller's route table (route_prefix -> app),
+Ref analog: python/ray/serve/_private/http_proxy.py:661 (HTTPProxyActor
+over uvicorn/ASGI). Re-design: a stdlib ``asyncio.start_server`` HTTP/1.1
+server inside a plain actor — no ASGI layer; JSON bodies map to handle
+args, results map back to JSON. One event loop handles every connection
+(keep-alive included); awaiting a response rides ObjectRef.__await__'s
+callback future, so an in-flight request costs a coroutine, not a
+thread. Explicit backpressure: at most ``max_inflight`` requests execute
+concurrently, at most ``max_queued`` wait behind them, and everything
+beyond that is refused with 503 + Retry-After (the reference's
+proxy-level backpressure knob family: max_ongoing_requests/queue len).
+
+Routes come from the controller's route table (route_prefix -> app),
 longest prefix wins, refreshed with a small TTL.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Tuple
 
 import ray_tpu
 
 PROXY_NAME = "SERVE_HTTP_PROXY"
 _ROUTES_TTL_S = 1.0
+_MAX_HEADER = 64 * 1024
+_MAX_BODY = 64 * 1024 * 1024
+_REQUEST_TIMEOUT_S = 60.0
+
+
+class _BadRequest(Exception):
+    pass
+
+
+class _CloseConnection(Exception):
+    """Raised after response bytes are already on the wire in a shape
+    that cannot be followed by another response (e.g. an aborted chunked
+    stream) — the connection must close, not 500."""
 
 
 class HTTPProxy:
-    """Actor hosting the HTTP server (create with max_concurrency > 1)."""
+    """Actor hosting the asyncio HTTP server."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_inflight: int = 64, max_queued: int = 128):
         self._routes = {}
         self._routes_at = 0.0
         self._controller = None
-        proxy = self
+        self._max_inflight = max_inflight
+        self._max_queued = max_queued
+        self._inflight = 0
+        self._queued = 0
+        # blocking runtime calls (handle submission, route refresh) run
+        # here so the event loop never blocks; stream pumps get their OWN
+        # pool because each occupies a thread for its stream's lifetime
+        # and must not starve short-lived submissions
+        self._pool = ThreadPoolExecutor(max_workers=16,
+                                        thread_name_prefix="serve-io")
+        self._stream_pool = ThreadPoolExecutor(
+            max_workers=max(max_inflight, 1),
+            thread_name_prefix="serve-stream")
+        self._refresh_fut = None  # in-flight route refresh (coalesced)
+        self._sem: Optional[asyncio.Semaphore] = None
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._port = 0
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
+        def run():
+            asyncio.set_event_loop(self._loop)
+            self._loop.run_until_complete(self._start(host, port))
+            self._started.set()
+            self._loop.run_forever()
 
-            def log_message(self, *a):  # silence per-request stderr spam
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="serve-http")
+        self._thread.start()
+        if not self._started.wait(30):
+            raise RuntimeError("http proxy failed to start")
+
+    async def _start(self, host: str, port: int):
+        self._sem = asyncio.Semaphore(self._max_inflight)
+        self._server = await asyncio.start_server(
+            self._handle_conn, host, port, limit=_MAX_HEADER)
+        self._port = self._server.sockets[0].getsockname()[1]
+
+    # -------------------------------------------------------- http plumbing
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter):
+        try:
+            while True:  # HTTP/1.1 keep-alive loop
+                req = await self._read_request(reader)
+                if req is None:
+                    break
+                method, path, headers, body = req
+                keep = headers.get("connection", "").lower() != "close"
+                try:
+                    await self._dispatch(method, path, headers, body,
+                                         writer)
+                except (ConnectionResetError, BrokenPipeError,
+                        _CloseConnection):
+                    break
+                except Exception as e:  # noqa: BLE001 — surface to client
+                    await self._reply(writer, 500, json.dumps(
+                        {"error": repr(e)}).encode())
+                if not keep:
+                    break
+        except _BadRequest as e:
+            try:
+                await self._reply(writer, 400, json.dumps(
+                    {"error": str(e)}).encode())
+            except Exception:  # noqa: BLE001
+                pass
+        except (asyncio.IncompleteReadError, asyncio.LimitOverrunError,
+                ConnectionResetError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001
                 pass
 
-            def _reply(self, code: int, payload: bytes,
-                       ctype: str = "application/json"):
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
+    async def _read_request(self, reader) -> Optional[Tuple]:
+        try:
+            head = await reader.readuntil(b"\r\n\r\n")
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            return None
+        lines = head.decode("latin1").split("\r\n")
+        parts = lines[0].split(" ")
+        if len(parts) < 3:
+            return None
+        method, target = parts[0], parts[1]
+        headers = {}
+        for line in lines[1:]:
+            if ":" in line:
+                k, v = line.split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        try:
+            n = int(headers.get("content-length") or 0)
+        except ValueError:
+            raise _BadRequest("invalid Content-Length") from None
+        if n < 0 or n > _MAX_BODY:
+            raise _BadRequest("Content-Length out of range")
+        body = await reader.readexactly(n) if n else b""
+        return method, target, headers, body
 
-            def _dispatch(self, body: Optional[bytes]):
-                path = self.path.split("?", 1)[0]
-                if path == "/-/healthz":
-                    self._reply(200, b'"ok"')
-                    return
-                if path == "/-/routes":
-                    self._reply(200, json.dumps(
-                        proxy._route_table()).encode())
-                    return
-                app = proxy._match(path)
-                if app is None:
-                    self._reply(404, json.dumps(
-                        {"error": f"no app mounted at {path}"}).encode())
-                    return
+    async def _reply(self, writer, code: int, payload: bytes,
+                     ctype: str = "application/json",
+                     extra: str = ""):
+        status = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  500: "Internal Server Error",
+                  503: "Service Unavailable"}.get(code, "OK")
+        writer.write(
+            f"HTTP/1.1 {code} {status}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(payload)}\r\n{extra}"
+            f"\r\n".encode("latin1") + payload)
+        await writer.drain()
+
+    # ------------------------------------------------------------ dispatch
+
+    async def _dispatch(self, method, target, headers, body, writer):
+        path = target.split("?", 1)[0]
+        if path == "/-/healthz":
+            await self._reply(writer, 200, b'"ok"')
+            return
+        if path == "/-/routes":
+            table = await self._route_table_async()
+            await self._reply(writer, 200, json.dumps(table).encode())
+            return
+        app = self._match(await self._route_table_async(), path)
+        if app is None:
+            await self._reply(writer, 404, json.dumps(
+                {"error": f"no app mounted at {path}"}).encode())
+            return
+        # ---- backpressure gate (FIFO: asyncio.Semaphore wakes waiters
+        # in acquisition order) ---------------------------------------
+        if self._inflight >= self._max_inflight:
+            if self._queued >= self._max_queued:
+                await self._reply(writer, 503, json.dumps(
+                    {"error": "proxy saturated"}).encode(),
+                    extra="Retry-After: 1\r\n")
+                return
+            self._queued += 1
+            try:
+                await self._sem.acquire()
+            finally:
+                self._queued -= 1
+        else:
+            await self._sem.acquire()
+        self._inflight += 1
+        try:
+            arg = None
+            if body:
                 try:
-                    arg = None
-                    if body:
-                        try:
-                            arg = json.loads(body)
-                        except json.JSONDecodeError:
-                            arg = body.decode("utf-8", "replace")
-                    handle = proxy._app_handle(app)
-                    if self.headers.get("X-Serve-Stream") == "1":
-                        # chunked ndjson streaming (ref: StreamingResponse
-                        # over a generator deployment, replica.py:339)
-                        gen = handle.options(stream=True).remote(arg)
-                        try:
-                            self.send_response(200)
-                            self.send_header("Content-Type",
-                                             "application/x-ndjson")
-                            self.send_header("Transfer-Encoding",
-                                             "chunked")
-                            self.end_headers()
-                            for item in gen:
-                                chunk = (json.dumps(item) + "\n").encode()
-                                self.wfile.write(
-                                    f"{len(chunk):x}\r\n".encode()
-                                    + chunk + b"\r\n")
-                            self.wfile.write(b"0\r\n\r\n")
-                        finally:
-                            # client disconnects mid-stream must not leak
-                            # the replica slot
-                            gen.close()
-                        return
-                    result = handle.remote(arg).result(timeout_s=60)
-                    if isinstance(result, bytes):
-                        self._reply(200, result,
-                                    "application/octet-stream")
-                    else:
-                        self._reply(200, json.dumps(result).encode())
-                except Exception as e:  # noqa: BLE001 — surface to client
-                    self._reply(500, json.dumps(
-                        {"error": repr(e)}).encode())
+                    arg = json.loads(body)
+                except json.JSONDecodeError:
+                    arg = body.decode("utf-8", "replace")
+            loop = asyncio.get_running_loop()
+            handle = await loop.run_in_executor(
+                self._pool, self._app_handle, app)
+            if headers.get("x-serve-stream") == "1":
+                await self._stream(handle, arg, writer)
+                return
+            # submission may block on routing metadata -> executor;
+            # awaiting the response rides the ref's callback future. The
+            # timeout frees the inflight slot if a replica hangs — a dead
+            # replica must not eat the proxy's whole concurrency budget
+            resp = await loop.run_in_executor(
+                self._pool, lambda: handle.remote(arg))
+            result = await asyncio.wait_for(resp, _REQUEST_TIMEOUT_S)
+            if isinstance(result, bytes):
+                await self._reply(writer, 200, result,
+                                  "application/octet-stream")
+            else:
+                await self._reply(writer, 200, json.dumps(result).encode())
+        finally:
+            self._inflight -= 1
+            self._sem.release()
 
-            def do_GET(self):
-                self._dispatch(None)
+    async def _stream(self, handle, arg, writer):
+        """Chunked ndjson streaming (ref: StreamingResponse over a
+        generator deployment, replica.py:339). The sync generator is
+        consumed on an executor thread feeding an asyncio queue; client
+        disconnects propagate back and release the replica slot."""
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue(maxsize=32)
+        done = object()
+        stop = threading.Event()
 
-            def do_POST(self):
-                n = int(self.headers.get("Content-Length") or 0)
-                self._dispatch(self.rfile.read(n) if n else None)
+        gen = await loop.run_in_executor(
+            self._pool, lambda: handle.options(stream=True).remote(arg))
+        headers_sent = False
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self._server.daemon_threads = True
-        self._port = self._server.server_address[1]
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True, name="serve-http")
-        self._thread.start()
+        def pump():
+            try:
+                for item in gen:
+                    if stop.is_set():
+                        break
+                    asyncio.run_coroutine_threadsafe(
+                        q.put(item), loop).result(timeout=60)
+                asyncio.run_coroutine_threadsafe(q.put(done), loop) \
+                    .result(timeout=60)
+            except Exception as e:  # noqa: BLE001
+                try:
+                    asyncio.run_coroutine_threadsafe(q.put(e), loop) \
+                        .result(timeout=60)
+                except Exception:
+                    pass
+            finally:
+                gen.close()  # releases the replica slot
+
+        self._stream_pool.submit(pump)
+        try:
+            while True:
+                item = await q.get()
+                if item is done:
+                    break
+                if isinstance(item, Exception):
+                    raise item
+                if not headers_sent:
+                    writer.write(b"HTTP/1.1 200 OK\r\n"
+                                 b"Content-Type: application/x-ndjson\r\n"
+                                 b"Transfer-Encoding: chunked\r\n\r\n")
+                    headers_sent = True
+                chunk = (json.dumps(item) + "\n").encode()
+                writer.write(f"{len(chunk):x}\r\n".encode()
+                             + chunk + b"\r\n")
+                await writer.drain()  # slow-client backpressure
+            if not headers_sent:
+                writer.write(b"HTTP/1.1 200 OK\r\n"
+                             b"Content-Type: application/x-ndjson\r\n"
+                             b"Transfer-Encoding: chunked\r\n\r\n")
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            raise
+        except Exception as e:  # noqa: BLE001
+            if not headers_sent:
+                stop.set()
+                raise  # no bytes on the wire yet: a clean 500 is fine
+            # mid-stream failure: a second status line would desync the
+            # chunked encoding — emit an error record, terminate the
+            # encoding, and close the connection
+            try:
+                chunk = (json.dumps({"error": repr(e)}) + "\n").encode()
+                writer.write(f"{len(chunk):x}\r\n".encode()
+                             + chunk + b"\r\n" + b"0\r\n\r\n")
+                await writer.drain()
+            except Exception:  # noqa: BLE001
+                pass
+            raise _CloseConnection() from e
+        finally:
+            stop.set()
 
     # ------------------------------------------------------------- helpers
 
@@ -121,20 +309,34 @@ class HTTPProxy:
             self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
         return self._controller
 
-    def _route_table(self) -> dict:
-        now = time.monotonic()
-        if now - self._routes_at > _ROUTES_TTL_S:
-            try:
-                self._routes = ray_tpu.get(
-                    self._controller_handle().get_routes.remote(), timeout=10)
-                self._routes_at = now
-            except Exception:
-                pass
+    def _refresh_routes(self) -> dict:
+        try:
+            self._routes = ray_tpu.get(
+                self._controller_handle().get_routes.remote(), timeout=10)
+            self._routes_at = time.monotonic()
+        except Exception:  # noqa: BLE001 — keep serving the stale table
+            pass
         return self._routes
 
-    def _match(self, path: str) -> Optional[str]:
+    async def _route_table_async(self) -> dict:
+        if time.monotonic() - self._routes_at > _ROUTES_TTL_S:
+            # coalesce: at most ONE controller RPC in flight no matter
+            # how many requests cross the TTL boundary together
+            if self._refresh_fut is None:
+                loop = asyncio.get_running_loop()
+                self._refresh_fut = loop.run_in_executor(
+                    self._pool, self._refresh_routes)
+                try:
+                    return await self._refresh_fut
+                finally:
+                    self._refresh_fut = None
+            return await asyncio.shield(self._refresh_fut)
+        return self._routes
+
+    @staticmethod
+    def _match(table: dict, path: str) -> Optional[str]:
         best, best_len = None, -1
-        for prefix, app in self._route_table().items():
+        for prefix, app in table.items():
             norm = prefix.rstrip("/") or "/"
             if (path == norm or path.startswith(norm.rstrip("/") + "/")
                     or norm == "/") and len(norm) > best_len:
@@ -156,6 +358,16 @@ class HTTPProxy:
     def ready(self) -> bool:
         return True
 
+    def stats(self) -> dict:
+        return {"inflight": self._inflight, "queued": self._queued}
+
     def stop(self):
-        self._server.shutdown()
+        def _shutdown():
+            if self._server is not None:
+                self._server.close()
+            self._loop.stop()
+
+        self._loop.call_soon_threadsafe(_shutdown)
+        self._pool.shutdown(wait=False)
+        self._stream_pool.shutdown(wait=False)
         return True

@@ -460,3 +460,69 @@ class TestHTTP:
             assert False, "expected 404"
         except urllib.error.HTTPError as e:
             assert e.code == 404
+
+
+class TestProxyBackpressure:
+    def test_saturated_proxy_queues_then_503s(self, serve_session):
+        """asyncio ingress backpressure (ref: the reference proxy's
+        max_ongoing_requests family): beyond max_inflight requests run
+        concurrently, max_queued wait, the rest get 503+Retry-After."""
+        import threading
+        import urllib.error
+        import urllib.request
+
+        from ray_tpu.serve.http_proxy import HTTPProxy
+
+        @serve.deployment(max_concurrent_queries=4)
+        def slow(payload):
+            time.sleep(1.0)
+            return "done"
+
+        serve.run(slow.bind(), name="slowapp", route_prefix="/slow")
+        proxy = HTTPProxy(max_inflight=2, max_queued=1)
+        base = f"http://127.0.0.1:{proxy.port()}"
+        codes = []
+        lock = threading.Lock()
+
+        def hit():
+            req = urllib.request.Request(base + "/slow", data=b'"x"')
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    with lock:
+                        codes.append(r.status)
+            except urllib.error.HTTPError as e:
+                with lock:
+                    codes.append(e.code)
+                    if e.code == 503:
+                        assert e.headers.get("Retry-After") == "1"
+
+        threads = [threading.Thread(target=hit) for _ in range(6)]
+        for t in threads:
+            t.start()
+            time.sleep(0.05)  # deterministic arrival order
+        for t in threads:
+            t.join(timeout=60)
+        proxy.stop()
+        # 2 in flight + 1 queued succeed eventually; the overflow 503s
+        assert sorted(codes).count(200) == 3, codes
+        assert sorted(codes).count(503) == 3, codes
+
+    def test_keepalive_connection_reuse(self, serve_session):
+        """One HTTP/1.1 connection serves several requests."""
+        import http.client
+
+        @serve.deployment
+        def echo(payload):
+            return payload
+
+        serve.run(echo.bind(), name="echoapp", route_prefix="/echo")
+        port = serve.start()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            for i in range(5):
+                conn.request("POST", "/echo", body=json.dumps(i))
+                resp = conn.getresponse()
+                assert resp.status == 200
+                assert json.loads(resp.read()) == i
+        finally:
+            conn.close()
