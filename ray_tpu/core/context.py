@@ -140,6 +140,8 @@ class CoreContext:
         self._cancelled: set = set()
         self._pinned: set = set()
         self._contained: Dict[ObjectID, list] = {}
+        self._free_buf: list = []       # buffered OBJECT_FREE id bins
+        self._free_lock = threading.Lock()
         # Borrow-handoff pins: refs we shipped inside a task RESULT stay
         # pinned here for a grace window, so our BORROW_REMOVE cannot
         # outrun the receiver's BORROW_ADD at the owner (chained borrow
@@ -563,6 +565,11 @@ class CoreContext:
         self._contained.pop(oid, None)
         with self._sub_lock:
             self._lineage.pop(oid, None)
+        entry = self.memory_store.peek(oid)
+        # any-node shm residency: freeing promptly lets that arena
+        # reclaim; peeking the in-process entry is far cheaper than
+        # probing the shm index on every small free
+        shm_resident = bool(entry is not None and entry.in_plasma)
         self.memory_store.evict(oid)
         if oid in self._pinned:
             self._pinned.discard(oid)
@@ -570,8 +577,27 @@ class CoreContext:
                 self.store.release(oid)
             except Exception:
                 pass
+        # Small (inline / memory-store) objects: buffer the head
+        # notification — at high call rates one OBJECT_FREE frame per
+        # freed return-ref doubles the driver->head message count
+        # (measured in the n_n actor microbench), and for these the
+        # message is pure GC accounting. Shm-resident objects flush
+        # IMMEDIATELY: delaying their free keeps arena bytes_in_use high
+        # and trips the head's spill threshold (measured 4x put-bandwidth
+        # collapse with a 0.2 s delay).
+        with self._free_lock:
+            self._free_buf.append(oid.binary())
+            flush = shm_resident or len(self._free_buf) >= 64
+        if flush:
+            self._flush_frees()
+
+    def _flush_frees(self):
+        with self._free_lock:
+            batch, self._free_buf = self._free_buf, []
+        if not batch:
+            return
         try:
-            self.head.send(P.OBJECT_FREE, [oid.binary()])
+            self.head.send(P.OBJECT_FREE, batch)
         except P.ConnectionLost:
             pass
 
@@ -761,6 +787,7 @@ class CoreContext:
                 for cls, st in classes:
                     self._drain_class(cls, st)
                 self._reap_idle_leases()
+                self._flush_frees()
             except Exception:
                 traceback.print_exc()
 
@@ -1210,9 +1237,17 @@ class CoreContext:
                 st.inflight[spec.task_id] = spec
                 to_send.append(spec)
             conn = st.conn
-        for spec in to_send:
+            # one frame, one pickle, one syscall for the whole drain —
+            # specs carry their seqno (the r3 PUSH_TASK_BATCH
+            # optimization, now on the actor path too). The send happens
+            # UNDER st.lock: two concurrent drains pop in order but would
+            # otherwise race to the socket, delivering actor tasks out of
+            # seqno order (the receiver executes in arrival order).
             try:
-                conn.send(P.PUSH_TASK, spec, spec.seqno)
+                if len(to_send) == 1:
+                    conn.send(P.PUSH_TASK, to_send[0], to_send[0].seqno)
+                elif to_send:
+                    conn.send(P.PUSH_TASK_BATCH, to_send)
             except P.ConnectionLost:
                 pass  # conn.on_close handles re-resolution
 
@@ -1573,6 +1608,7 @@ class CoreContext:
         return self.head.call(P.NODE_INFO, timeout=30)[0]
 
     def shutdown(self):
+        self._flush_frees()  # before _shutdown flips: conns still up
         self._shutdown = True
         self.events.stop()
         self._submit_event.set()

@@ -102,7 +102,6 @@ class A2CLearner:
                 self.params, self.opt_state,
                 jax.tree.map(lambda g: g / n, acc))
             return {k: v / n for k, v in metric_sums.items()}
-        metrics = {}
         self.params, self.opt_state, metrics = self._train_step(
             self.params, self.opt_state,
             {k: jnp.asarray(v) for k, v in batch.items()})

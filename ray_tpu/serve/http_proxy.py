@@ -65,6 +65,7 @@ class HTTPProxy:
             max_workers=max(max_inflight, 1),
             thread_name_prefix="serve-stream")
         self._refresh_fut = None  # in-flight route refresh (coalesced)
+        self._handles = {}        # app -> DeploymentHandle (TTL = routes)
         self._sem: Optional[asyncio.Semaphore] = None
         self._loop = asyncio.new_event_loop()
         self._started = threading.Event()
@@ -181,20 +182,20 @@ class HTTPProxy:
                 {"error": f"no app mounted at {path}"}).encode())
             return
         # ---- backpressure gate (FIFO: asyncio.Semaphore wakes waiters
-        # in acquisition order) ---------------------------------------
-        if self._inflight >= self._max_inflight:
-            if self._queued >= self._max_queued:
-                await self._reply(writer, 503, json.dumps(
-                    {"error": "proxy saturated"}).encode(),
-                    extra="Retry-After: 1\r\n")
-                return
-            self._queued += 1
-            try:
-                await self._sem.acquire()
-            finally:
-                self._queued -= 1
-        else:
+        # in acquisition order). EVERY acquirer counts as queued while it
+        # waits — gating on an inflight counter instead would let
+        # requests arriving in the release->wakeup window wait uncounted,
+        # bypassing the max_queued cap.
+        if self._sem.locked() and self._queued >= self._max_queued:
+            await self._reply(writer, 503, json.dumps(
+                {"error": "proxy saturated"}).encode(),
+                extra="Retry-After: 1\r\n")
+            return
+        self._queued += 1
+        try:
             await self._sem.acquire()
+        finally:
+            self._queued -= 1
         self._inflight += 1
         try:
             arg = None
@@ -239,28 +240,40 @@ class HTTPProxy:
             self._pool, lambda: handle.options(stream=True).remote(arg))
         headers_sent = False
 
+        def put_item(item) -> bool:
+            """Enqueue from the pump thread; abandons quickly once the
+            consumer stopped (a slow/gone client must not pin this pool
+            thread for a long blocking put)."""
+            while not stop.is_set():
+                fut = asyncio.run_coroutine_threadsafe(q.put(item), loop)
+                try:
+                    fut.result(timeout=1.0)
+                    return True
+                except TimeoutError:
+                    fut.cancel()  # pending put would double-enqueue
+                except Exception:  # noqa: BLE001 — loop closing
+                    return False
+            return False
+
         def pump():
             try:
                 for item in gen:
-                    if stop.is_set():
-                        break
-                    asyncio.run_coroutine_threadsafe(
-                        q.put(item), loop).result(timeout=60)
-                asyncio.run_coroutine_threadsafe(q.put(done), loop) \
-                    .result(timeout=60)
+                    if not put_item(item):
+                        return
+                put_item(done)
             except Exception as e:  # noqa: BLE001
-                try:
-                    asyncio.run_coroutine_threadsafe(q.put(e), loop) \
-                        .result(timeout=60)
-                except Exception:
-                    pass
+                put_item(e)
             finally:
                 gen.close()  # releases the replica slot
 
         self._stream_pool.submit(pump)
         try:
             while True:
-                item = await q.get()
+                # bounded inter-item gap: a hung replica generator must
+                # not hold this inflight slot forever (mirrors the
+                # non-stream path's request timeout)
+                item = await asyncio.wait_for(q.get(),
+                                              _REQUEST_TIMEOUT_S)
                 if item is done:
                     break
                 if isinstance(item, Exception):
@@ -314,6 +327,10 @@ class HTTPProxy:
             self._routes = ray_tpu.get(
                 self._controller_handle().get_routes.remote(), timeout=10)
             self._routes_at = time.monotonic()
+            # ingress handles share the routes' freshness window; a
+            # redeploy that changes an app's ingress is picked up on the
+            # next refresh
+            self._handles = {}
         except Exception:  # noqa: BLE001 — keep serving the stale table
             pass
         return self._routes
@@ -346,9 +363,16 @@ class HTTPProxy:
     def _app_handle(self, app: str):
         from .handle import DeploymentHandle
 
-        ingress = ray_tpu.get(
-            self._controller_handle().get_ingress.remote(app), timeout=10)
-        return DeploymentHandle(ingress, app)
+        handle = self._handles.get(app)
+        if handle is None:
+            # one controller RPC per app per routes-refresh window — NOT
+            # per request (the per-request RPC dominated proxy latency)
+            ingress = ray_tpu.get(
+                self._controller_handle().get_ingress.remote(app),
+                timeout=10)
+            handle = DeploymentHandle(ingress, app)
+            self._handles[app] = handle
+        return handle
 
     # -------------------------------------------------------------- public
 

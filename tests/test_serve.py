@@ -481,7 +481,7 @@ class TestProxyBackpressure:
         serve.run(slow.bind(), name="slowapp", route_prefix="/slow")
         proxy = HTTPProxy(max_inflight=2, max_queued=1)
         base = f"http://127.0.0.1:{proxy.port()}"
-        codes = []
+        codes, retry_afters = [], []
         lock = threading.Lock()
 
         def hit():
@@ -494,7 +494,9 @@ class TestProxyBackpressure:
                 with lock:
                     codes.append(e.code)
                     if e.code == 503:
-                        assert e.headers.get("Retry-After") == "1"
+                        # collected here, asserted on the MAIN thread —
+                        # an assert in a worker thread never fails a test
+                        retry_afters.append(e.headers.get("Retry-After"))
 
         threads = [threading.Thread(target=hit) for _ in range(6)]
         for t in threads:
@@ -506,6 +508,7 @@ class TestProxyBackpressure:
         # 2 in flight + 1 queued succeed eventually; the overflow 503s
         assert sorted(codes).count(200) == 3, codes
         assert sorted(codes).count(503) == 3, codes
+        assert retry_afters == ["1", "1", "1"], retry_afters
 
     def test_keepalive_connection_reuse(self, serve_session):
         """One HTTP/1.1 connection serves several requests."""
