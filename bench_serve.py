@@ -115,19 +115,18 @@ def _percentiles(lat):
 
 def bench_continuous(cfg, params, *, slots, max_prompt, max_new,
                      clients, duration_s, decode_chunk=16,
-                     fetch_every=4):
+                     fetch_every=4, max_inflight=6):
     from ray_tpu.models.engine import InferenceEngine
 
     eng = InferenceEngine(params, cfg, slots=slots,
                           max_prompt_len=max_prompt,
                           max_new_tokens=max_new,
                           decode_chunk=decode_chunk,
-                          fetch_every=fetch_every).serve_forever()
+                          fetch_every=fetch_every,
+                          max_inflight=max_inflight)
+    # compile every (group, bucket) prefill + the decode chunk up front
+    eng.warmup().serve_forever()
     try:
-        # warm every compiled program (each prefill bucket + decode chunk)
-        for bucket in eng._buckets:
-            eng.generate(list(range(1, bucket + 1)), 2, timeout=1200)
-
         def submit(prompt, want):
             return eng.generate(prompt, want, timeout=600)
 
@@ -138,6 +137,9 @@ def bench_continuous(cfg, params, *, slots, max_prompt, max_new,
                 "rps": round(n / wall, 2),
                 "useful_tokens_per_s": round(toks / wall, 1),
                 "decode_steps": eng.stats["decode_steps"],
+                "prefills": eng.stats["prefills"],
+                "prefill_dispatches": eng.stats["prefill_dispatches"],
+                "fetches": eng.stats["fetches"],
                 **_percentiles(lat)}
     finally:
         eng.shutdown()
@@ -246,6 +248,7 @@ def main():
     ap.add_argument("--out", default="SERVE_BENCH_r5.json")
     ap.add_argument("--decode-chunk", type=int, default=16)
     ap.add_argument("--fetch-every", type=int, default=4)
+    ap.add_argument("--max-inflight", type=int, default=6)
     ap.add_argument("--proxy-only", action="store_true",
                     help="measure the HTTP ingress only (no model)")
     ap.add_argument("--proxy-clients", type=int, default=16)
@@ -277,7 +280,8 @@ def main():
                             max_new=args.max_new, clients=args.clients,
                             duration_s=args.duration,
                             decode_chunk=args.decode_chunk,
-                            fetch_every=args.fetch_every)
+                            fetch_every=args.fetch_every,
+                            max_inflight=args.max_inflight)
     print(json.dumps(cont), file=sys.stderr)
     if args.skip_cohort:
         print(json.dumps(cont))

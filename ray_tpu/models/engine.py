@@ -15,10 +15,17 @@ the reference delegates to external vLLM workers for, built TPU-first:
     batched program (per-row cache positions, per-row RoPE), then the
     host admits queued prompts into any slots that finished — finished
     sequences never block running ones.
-  - Prefill is a separate B=1 program per power-of-two prompt bucket
-    (bounded compile count) whose K/V lands directly in the slot row;
-    prefills interleave with decode steps, so time-to-first-token stays
-    bounded under load.
+  - Prefill is a separate program per (group size, prompt bucket) pair
+    (both power-of-two, bounded compile count) whose K/V lands directly
+    in the slot rows; queued prompts admit in groups of up to 4 as ONE
+    batched program, and prefills interleave with decode chunks so
+    time-to-first-token stays bounded under load.
+  - Dispatch and fetch are pipelined across two threads: the scheduler
+    thread admits + dispatches (cheap async calls), the fetcher thread
+    does the device->host token transfers, which overlap with queued
+    execution — on a tunneled backend the ~100x gap between dispatch
+    cost and fetch round-trip makes this split the difference between
+    losing and beating cohort batching (bench_serve.py).
   - Sampling happens on-device; the host sees B int32s per step — the
     decode loop's host<->device traffic is O(slots), not O(vocab).
   - Tensor parallelism comes from sharding, not new code: params carry
@@ -84,21 +91,43 @@ def prefill_slot(params: Params, cache: SlotCache, tokens: jax.Array,
     real token at ``start``) and write its K/V into slot row ``slot``;
     -> (cache, first sampled token []). One compiled program per bucket P.
     """
-    P = tokens.shape[1]
-    x, c1 = _prefill_hidden(params, tokens, cfg, P, start[None])
-    last = _final_logits(params, x[:, -1:], cfg)[:, 0]  # [1, V]
-    tok = _sample(last, rng, greedy, temperature)[0]
-    # c1["k"]: [L, 1, P, KV, hd] -> row `slot`, seq offset 0
+    cache, toks = prefill_slots(params, cache, tokens, slot[None],
+                                start[None], rng, cfg, greedy, temperature)
+    return cache, toks[0]
+
+
+@partial(jax.jit, static_argnames=("cfg", "greedy"), donate_argnums=(1,))
+def prefill_slots(params: Params, cache: SlotCache, tokens: jax.Array,
+                  slots: jax.Array, starts: jax.Array, rng: jax.Array,
+                  cfg: TransformerConfig, greedy: bool = True,
+                  temperature: float = 1.0):
+    """Batched prefill: ``tokens`` [K, P] (left-padded to one shared
+    bucket, first real token of row i at ``starts[i]``) lands in cache
+    rows ``slots`` [K]; -> (cache, first sampled tokens [K]).
+
+    One compiled program per (K, P) pair; K is kept to a few power-of-two
+    group sizes by the scheduler. Batching prefills is a dispatch-count
+    lever: on a tunneled backend each program dispatch costs ~ms and the
+    B=1 prefill wastes most of the MXU, so admitting 4 queued prompts as
+    one [4, P] program is ~3x cheaper than 4 serial [1, P] programs.
+    """
+    K, P = tokens.shape
+    x, cK = _prefill_hidden(params, tokens, cfg, P, starts)
+    last = _final_logits(params, x[:, -1:], cfg)[:, 0]  # [K, V]
+    toks = _sample(last, rng, greedy, temperature)      # [K]
+    # cK["k"]: [L, K, P, KV, hd] -> row i into slot row slots[i]
+    k, v = cache["k"], cache["v"]
     zero = jnp.zeros((), jnp.int32)
-    k = jax.lax.dynamic_update_slice(
-        cache["k"], c1["k"].astype(cache["k"].dtype),
-        (zero, slot, zero, zero, zero))
-    v = jax.lax.dynamic_update_slice(
-        cache["v"], c1["v"].astype(cache["v"].dtype),
-        (zero, slot, zero, zero, zero))
+    for i in range(K):  # K is static: unrolled row writes
+        k = jax.lax.dynamic_update_slice(
+            k, cK["k"][:, i:i + 1].astype(k.dtype),
+            (zero, slots[i], zero, zero, zero))
+        v = jax.lax.dynamic_update_slice(
+            v, cK["v"][:, i:i + 1].astype(v.dtype),
+            (zero, slots[i], zero, zero, zero))
     return {"k": k, "v": v,
-            "pos": cache["pos"].at[slot].set(P),
-            "start": cache["start"].at[slot].set(start)}, tok
+            "pos": cache["pos"].at[slots].set(P),
+            "start": cache["start"].at[slots].set(starts)}, toks
 
 
 def _write_rows(layer_cache, kv, pos):
@@ -239,7 +268,7 @@ class InferenceEngine:
                  temperature: float = 1.0, eos_id: int = -1,
                  pad_id: int = 0, mesh=None, seed: int = 0,
                  min_bucket: int = 16, decode_chunk: int = 4,
-                 fetch_every: int = 1):
+                 fetch_every: int = 1, max_inflight: int = 6):
         self.cfg = cfg
         self.slots = int(slots)
         self.max_prompt_len = int(max_prompt_len)
@@ -252,14 +281,19 @@ class InferenceEngine:
         # multi-step scheduling: decode_chunk substeps per dispatch (one
         # host round-trip per chunk); admission happens between chunks
         self.decode_chunk = max(1, int(decode_chunk))
-        # fetch batching: accumulate this many dispatched chunks, then
-        # concatenate their token outputs ON DEVICE and fetch once — on
-        # backends where a device->host fetch serializes with execution
-        # (tunneled TPU), the fetch round trip is the dominant per-chunk
-        # cost and amortizing it this way is the main throughput lever.
-        # The price is bookkeeping latency: finishes are detected (and
-        # slots refilled) every fetch_every chunks.
+        # fetch batching (inline step() mode): accumulate this many
+        # dispatched chunks, then concatenate their token outputs ON
+        # DEVICE and fetch once. Under serve_forever the dedicated
+        # fetcher thread self-paces instead (drain everything pending
+        # per cycle) and this knob is unused.
         self.fetch_every = max(1, int(fetch_every))
+        # pipelined mode: how many dispatched-but-unfetched decode chunks
+        # may exist before the dispatch loop waits for the fetcher.
+        # Measured on the tunneled TPU: a device->host fetch costs
+        # ~240 ms wall but OVERLAPS with queued execution, so the win is
+        # dispatching ahead while a previous fetch is in flight; the cap
+        # bounds result-delivery latency (~cap * chunk_time + one fetch).
+        self.max_inflight = max(1, int(max_inflight))
         self._max_len = self.max_prompt_len + self.max_new_tokens
         self._buckets = []
         b = max(8, int(min_bucket))
@@ -293,6 +327,9 @@ class InferenceEngine:
         # fetch is pure result delivery. eos can only shorten a plan; it
         # is reclaimed when a fetch reveals it.
         self._slot_left: List[int] = [0] * self.slots
+        # slots admitted but not yet decoded once: their next chunk's
+        # echo column carries the prefill-sampled token (emit from col 0)
+        self._slot_new: List[bool] = [False] * self.slots
         # the token chain lives ON DEVICE: chunk N+1's inputs are chunk
         # N's last samples (or a prefill's first sample, merged in with
         # .at[slot].set) — the host never syncs to keep the chain going
@@ -306,6 +343,10 @@ class InferenceEngine:
         self._lock = threading.Lock()   # guards step() vs concurrent step()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # pipelined fetcher (serve_forever only): consumes _inflight so
+        # the dispatch loop never blocks on a device->host transfer
+        self._fetcher: Optional[threading.Thread] = None
+        self._fetch_evt = threading.Event()   # work for the fetcher
         # set when the step loop died on an unrecoverable error (device /
         # XLA failure); submit() raises from then on instead of queueing
         # work that nothing will ever drain. _death_lock orders submit's
@@ -314,7 +355,8 @@ class InferenceEngine:
         self._fatal: Optional[BaseException] = None
         self._death_lock = threading.Lock()
         # running counters for benchmarking / observability
-        self.stats = {"prefills": 0, "decode_steps": 0, "tokens_out": 0,
+        self.stats = {"prefills": 0, "prefill_dispatches": 0,
+                      "decode_steps": 0, "fetches": 0, "tokens_out": 0,
                       "requests_done": 0}
 
     # -------------------------------------------------------- submission
@@ -376,21 +418,61 @@ class InferenceEngine:
                 return b
         return self.max_prompt_len
 
-    def _admit(self, req: _Request, slot: int):
-        """Dispatch a prefill into ``slot`` (ASYNC — the sampled first
-        token joins the device-side chain; its value reaches the host in
-        the next chunk's echoed input column)."""
-        P = self._bucket(len(req.prompt))
-        toks = np.full((1, P), self.pad_id, np.int32)
-        toks[0, P - len(req.prompt):] = req.prompt
-        start = P - len(req.prompt)
-        self.cache, tok = prefill_slot(
+    def _admit_group(self, group: List[tuple]):
+        """Dispatch ONE batched prefill for ``group`` = [(slot, req)]
+        (ASYNC — the sampled first tokens join the device-side chain;
+        their values reach the host in the next chunk's echo column).
+        All rows pad to the largest member's bucket so the group shares
+        one compiled (K, P) program."""
+        K = len(group)
+        P = max(self._bucket(len(req.prompt)) for _, req in group)
+        toks = np.full((K, P), self.pad_id, np.int32)
+        slots = np.zeros(K, np.int32)
+        starts = np.zeros(K, np.int32)
+        for i, (slot, req) in enumerate(group):
+            toks[i, P - len(req.prompt):] = req.prompt
+            slots[i] = slot
+            starts[i] = P - len(req.prompt)
+        self.cache, first = prefill_slots(
             self.params, self.cache, jnp.asarray(toks),
-            jnp.asarray(slot, jnp.int32), jnp.asarray(start, jnp.int32),
+            jnp.asarray(slots), jnp.asarray(starts),
             self._next_rng(), self.cfg, self.greedy, self.temperature)
-        self._slot_req[slot] = req
-        self._next_tok_dev = self._next_tok_dev.at[slot].set(tok)
-        self.stats["prefills"] += 1
+        self._next_tok_dev = self._next_tok_dev.at[jnp.asarray(slots)] \
+            .set(first)
+        for slot, req in group:
+            self._slot_req[slot] = req
+        self.stats["prefills"] += K
+        self.stats["prefill_dispatches"] += 1
+
+    _GROUP_SIZES = (4, 2, 1)  # compiled-prefill batch sizes, largest first
+
+    def warmup(self):
+        """Compile every program the serving loop can hit (per-bucket x
+        per-group-size prefills, the decode chunk) so no compile lands
+        mid-traffic. Resets slot state afterwards; call before serving."""
+        sizes = [s for s in self._GROUP_SIZES if s <= self.slots]
+        for bucket in self._buckets:
+            for K in sizes:
+                toks = np.full((K, bucket), self.pad_id, np.int32)
+                toks[:, -1] = 1
+                self.cache, _ = prefill_slots(
+                    self.params, self.cache, jnp.asarray(toks),
+                    jnp.arange(K, dtype=jnp.int32),
+                    jnp.full((K,), bucket - 1, jnp.int32),
+                    self._next_rng(), self.cfg, self.greedy,
+                    self.temperature)
+        cache, toks = decode_slots(
+            self.params, self.cache, self._next_tok_dev,
+            jnp.ones(self.slots, bool), self._next_rng(), self.cfg,
+            self.greedy, self.temperature, self.eos_id,
+            steps=self.decode_chunk)
+        jax.block_until_ready(toks)
+        # reset bookkeeping: positions to zero, junk K/V is unreachable
+        self.cache = {"k": cache["k"], "v": cache["v"],
+                      "pos": jnp.zeros_like(cache["pos"]),
+                      "start": jnp.zeros_like(cache["start"])}
+        self._next_tok_dev = jnp.zeros(self.slots, jnp.int32)
+        return self
 
     def _emit_to(self, req: _Request, slot: int, tok: int):
         """Record one generated token; on an eos finish, reclaim the
@@ -415,15 +497,34 @@ class InferenceEngine:
         with self._lock:
             return self._step_locked()
 
-    def _pow2_floor(self, x: int) -> int:
-        return 1 << (max(1, min(x, self.decode_chunk)).bit_length() - 1)
-
     def _step_locked(self) -> bool:
         # 1) admission: a slot whose planned occupancy ran out is free —
         #    no fetch needed to know it (delivery of its resident's
         #    tokens rides the already-recorded snapshots). Prefills are
-        #    async dispatches chained on the device queue.
-        admitted = set()
+        #    batched async dispatches chained on the device queue.
+        admitted = self._admit_locked()
+        # 2) dispatch one full-width decode chunk (async) when there is
+        #    planned work and (pipelined mode) fetch headroom.
+        dispatched = self._dispatch_locked()
+        # 3) delivery. Inline mode fetches here (one device-side concat +
+        #    ONE transfer per fetch_every chunks); pipelined mode hands
+        #    the accumulated chunks to the fetcher thread instead, so the
+        #    dispatch loop never blocks on a device->host round trip.
+        processed = False
+        if self._fetcher is None:
+            if self._inflight and (len(self._inflight) >= self.fetch_every
+                                   or not dispatched):
+                pending, self._inflight = self._inflight, []
+                self._deliver_locked(self._fetch_chunks(pending), pending)
+                processed = True
+        elif self._inflight:
+            self._fetch_evt.set()
+        return bool(admitted or dispatched or processed)
+
+    def _admit_locked(self) -> int:
+        """Admit queued prompts into planned-free slots; dispatches one
+        batched prefill per power-of-two group. Returns #admitted."""
+        take: List[tuple] = []
         for slot in range(self.slots):
             if self._slot_left[slot] > 0:
                 continue
@@ -433,91 +534,98 @@ class InferenceEngine:
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
-                continue
+                break
+            take.append((slot, req))
+        i = 0
+        while i < len(take):
+            K = next(k for k in self._GROUP_SIZES if k <= len(take) - i)
+            group = take[i:i + K]
+            i += K
             try:
-                self._admit(req, slot)
+                self._admit_group(group)
+            except BaseException as e:
+                # a failed prefill dispatch poisons the whole engine
+                # (device/XLA error); fail this group's waiters AND every
+                # later dequeued-but-ungrouped request here — none of
+                # them are queued or slotted anymore, so _die cannot see
+                # them and they would otherwise hang forever
+                for _slot, req in group + take[i:]:
+                    req.error = e
+                    req.finish("error")
+                raise
+            for slot, req in group:
                 # the plan includes the prefill-sampled first token; it
                 # reaches the host in the next chunk's echo column
                 self._slot_left[slot] = req.max_new_tokens
-                admitted.add(slot)
-            except BaseException as e:  # surface to the waiter, keep going
-                req.error = e
-                req.finish("error")
-                continue
-        # 2) dispatch one decode chunk (async) for every slot with planned
-        #    work. Width adapts: under admission pressure the chunk is cut
-        #    at the earliest planned release (power-of-two widths bound
-        #    the compile count); otherwise the full decode_chunk runs.
+                self._slot_new[slot] = True
+        return len(take)
+
+    def _dispatch_locked(self) -> bool:
         active_slots = [s for s in range(self.slots)
                         if self._slot_left[s] > 0]
-        dispatched = False
-        if active_slots:
-            if self._queue.qsize() > 0:
-                need = min(self._slot_left[s] - (1 if s in admitted else 0)
-                           for s in active_slots)
-                width = self._pow2_floor(max(1, need))
-            else:
-                width = self.decode_chunk
-            snapshot = []
-            for slot in active_slots:
-                req = self._slot_req[slot]
-                new = slot in admitted
-                take = min(self._slot_left[slot], width + (1 if new else 0))
-                snapshot.append((slot, req, 0 if new else 1, take))
-                self._slot_left[slot] = max(
-                    0, self._slot_left[slot] - (width + 1 if new else width))
-            active = np.zeros(self.slots, bool)
-            active[active_slots] = True
-            self.cache, toks = decode_slots(
-                self.params, self.cache, self._next_tok_dev,
-                jnp.asarray(active), self._next_rng(), self.cfg,
-                self.greedy, self.temperature, self.eos_id,
-                steps=width)
-            self._next_tok_dev = toks[:, -1]
-            self.stats["decode_steps"] += width
-            self._inflight.append((toks, snapshot))
-            dispatched = True
-        # 3) flush: one device-side concat + ONE transfer for every
-        #    accumulated chunk, once fetch_every are pending (or the
-        #    engine has nothing left to dispatch). The fetch round trip
-        #    is amortized over fetch_every chunks of device compute.
-        processed = False
-        if self._inflight and (len(self._inflight) >= self.fetch_every
-                               or not dispatched):
-            pending, self._inflight = self._inflight, []
-            # pad every chunk to one uniform width before the device-side
-            # concat: adaptive widths would otherwise make the concat's
-            # shape signature (and its compiled program) vary per width
-            # combination
-            W = self.decode_chunk + 1
-            parts = [t if t.shape[1] == W
-                     else jnp.pad(t, ((0, 0), (0, W - t.shape[1])))
-                     for t, _ in pending]
-            big = np.asarray(parts[0] if len(parts) == 1
-                             else jnp.concatenate(parts, axis=1))
-            for i, (toks_dev, snap) in enumerate(pending):
-                width = toks_dev.shape[1]
-                seg = big[:, i * W:i * W + width]
-                for slot, req, from_col, take in snap:
+        if not active_slots:
+            return False
+        if self._fetcher is not None and \
+                len(self._inflight) >= self.max_inflight:
+            return False  # dispatch-ahead cap: wait for the fetcher
+        width = self.decode_chunk
+        snapshot = []
+        for slot in active_slots:
+            new = self._slot_new[slot]
+            self._slot_new[slot] = False
+            take = min(self._slot_left[slot], width + (1 if new else 0))
+            snapshot.append((slot, self._slot_req[slot],
+                             0 if new else 1, take))
+            self._slot_left[slot] = max(
+                0, self._slot_left[slot] - (width + 1 if new else width))
+        active = np.zeros(self.slots, bool)
+        active[active_slots] = True
+        self.cache, toks = decode_slots(
+            self.params, self.cache, self._next_tok_dev,
+            jnp.asarray(active), self._next_rng(), self.cfg,
+            self.greedy, self.temperature, self.eos_id, steps=width)
+        self._next_tok_dev = toks[:, -1]
+        self.stats["decode_steps"] += width
+        self._inflight.append((toks, snapshot))
+        return True
+
+    def _fetch_chunks(self, pending) -> np.ndarray:
+        """One device-side concat + ONE host transfer for ``pending``
+        chunks (each [B, decode_chunk+1]). Called outside the lock by
+        the fetcher; inline mode calls it under the lock."""
+        parts = [t for t, _ in pending]
+        big = np.asarray(parts[0] if len(parts) == 1
+                         else jnp.concatenate(parts, axis=1))
+        self.stats["fetches"] += 1
+        return big
+
+    def _deliver_locked(self, big: np.ndarray, pending) -> None:
+        W = self.decode_chunk + 1
+        for i, (_toks_dev, snap) in enumerate(pending):
+            seg = big[:, i * W:(i + 1) * W]
+            for slot, req, from_col, take in snap:
+                if req.done.is_set():
+                    continue  # finished in an earlier chunk
+                for t in range(from_col, from_col + take):
+                    self._emit_to(req, slot, int(seg[slot, t]))
                     if req.done.is_set():
-                        continue  # finished in an earlier chunk
-                    for t in range(from_col, from_col + take):
-                        self._emit_to(req, slot, int(seg[slot, t]))
-                        if req.done.is_set():
-                            break  # rest of the row is frozen eos/junk
-            processed = True
-        return bool(admitted or dispatched or processed)
+                        break  # rest of the row is frozen eos/junk
 
     # ---------------------------------------------------- background loop
 
     def serve_forever(self):
-        """Run the engine on a daemon thread until ``shutdown()``."""
+        """Run the engine on a daemon thread until ``shutdown()``, plus a
+        fetcher thread that pipelines device->host transfers behind the
+        dispatch loop (the transfer overlaps queued device execution, so
+        its ~latency costs delivery time, never throughput)."""
         if self._thread is not None:
             return self
         self._stop.clear()
 
         def loop():
             while not self._stop.is_set():
+                if self._fatal is not None:
+                    return
                 try:
                     busy = self.step()
                 except BaseException as e:
@@ -528,13 +636,49 @@ class InferenceEngine:
                     self._die(e)
                     return
                 if not busy:
-                    # idle: sleep until a submission arrives
+                    # idle or at the dispatch-ahead cap: PARK until state
+                    # can change (submit(), fetcher taking chunks, or
+                    # delivery all set _work). A busy-spin here would eat
+                    # the host core the fetcher and request threads need
+                    # — measured as ~half the device sitting idle on a
+                    # 1-core host.
                     self._work.clear()
-                    if not self._queue.qsize():
-                        self._work.wait(timeout=0.05)
+                    self._work.wait(timeout=0.05)
+
+        def fetch_loop():
+            while True:
+                if self._fatal is not None:
+                    return
+                if self._stop.is_set() and not self._inflight:
+                    return
+                self._fetch_evt.wait(timeout=0.05)
+                with self._lock:
+                    pending, self._inflight = self._inflight, []
+                    if not pending:
+                        self._fetch_evt.clear()
+                if not pending:
+                    continue
+                # taking the chunks made room under the dispatch cap —
+                # wake the dispatch loop BEFORE the slow transfer so it
+                # overlaps with queued execution
+                self._work.set()
+                try:
+                    big = self._fetch_chunks(pending)  # blocking transfer
+                    with self._lock:
+                        self._deliver_locked(big, pending)
+                except BaseException as e:
+                    self._die(e)
+                    return
+                # room under the cap + possibly eos-freed slots
+                self._work.set()
+
         self._thread = threading.Thread(target=loop, name="llm-engine",
                                         daemon=True)
+        self._fetcher = threading.Thread(target=fetch_loop,
+                                         name="llm-engine-fetch",
+                                         daemon=True)
         self._thread.start()
+        self._fetcher.start()
         return self
 
     def _check_alive(self):
@@ -548,6 +692,7 @@ class InferenceEngine:
         failed = [r for r in self._slot_req if r is not None]
         self._slot_req = [None] * self.slots
         self._slot_left = [0] * self.slots
+        self._slot_new = [False] * self.slots
         with self._death_lock:
             # after this block no submit() can enqueue: _fatal is visible
             # to every subsequent check, and the queue is drained
@@ -568,9 +713,13 @@ class InferenceEngine:
     def shutdown(self):
         self._stop.set()
         self._work.set()
+        self._fetch_evt.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        if self._fetcher is not None:
+            self._fetcher.join(timeout=10)
+            self._fetcher = None
 
     # ------------------------------------------------------- conveniences
 

@@ -250,7 +250,16 @@ class HTTPProxy:
                     fut.result(timeout=1.0)
                     return True
                 except TimeoutError:
-                    fut.cancel()  # pending put would double-enqueue
+                    # cancel() returning False means the put WON the race
+                    # with the timeout and (is) completing — retrying
+                    # then would enqueue the item twice, corrupting the
+                    # stream; wait out its final state instead
+                    if not fut.cancel():
+                        try:
+                            fut.result(timeout=5.0)
+                            return True
+                        except Exception:  # noqa: BLE001
+                            return False
                 except Exception:  # noqa: BLE001 — loop closing
                     return False
             return False

@@ -298,3 +298,58 @@ def test_step_loop_death_fails_all_waiters(model):
         eng.submit([4, 5])
     with pytest.raises(RuntimeError, match="dead"):
         eng.submit_stream([4, 5])
+
+
+def test_batched_prefill_groups_match_serial(model):
+    """6 simultaneous submissions into 6 free slots admit as 4+2 batched
+    prefills (one dispatch each) and every request must still match its
+    solo greedy generation — grouping changes dispatch count, not math."""
+    cfg, params = model
+    eng = InferenceEngine(params, cfg, slots=6, max_prompt_len=16,
+                          max_new_tokens=6)
+    prompts = [[i + 1, (3 * i) % 11 + 1] for i in range(6)]
+    reqs = [eng.submit(p) for p in prompts]
+    for _ in range(100):
+        if all(r.done.is_set() for r in reqs):
+            break
+        eng.step()
+    for p, r in zip(prompts, reqs):
+        assert list(r.tokens) == _reference_tokens(params, cfg, p, 6)
+    assert eng.stats["prefills"] == 6
+    assert eng.stats["prefill_dispatches"] == 2  # groups of 4 + 2
+
+
+def test_pipelined_fetcher_matches_inline(model):
+    """serve_forever now fetches on a separate thread; tokens must be
+    identical to the inline-step path and all waiters must complete."""
+    cfg, params = model
+    prompts = [[3, 1, 4], [15, 9, 2, 6], [5, 3], [8, 8, 8],
+               [2, 7, 1, 8], [9, 9]]
+    eng = InferenceEngine(params, cfg, slots=2, max_prompt_len=16,
+                          max_new_tokens=8, decode_chunk=3,
+                          max_inflight=2).serve_forever()
+    try:
+        reqs = [eng.submit(p) for p in prompts]
+        for r in reqs:
+            assert r.done.wait(120)
+            assert r.error is None
+        for p, r in zip(prompts, reqs):
+            assert list(r.tokens) == _reference_tokens(params, cfg, p, 8)
+        assert eng.stats["fetches"] >= 1
+    finally:
+        eng.shutdown()
+
+
+def test_warmup_compiles_and_resets(model):
+    cfg, params = model
+    eng = InferenceEngine(params, cfg, slots=4, max_prompt_len=16,
+                          max_new_tokens=6)
+    eng.warmup()
+    # warmup must leave no residue: a fresh request still matches solo
+    req = eng.submit([3, 1, 4, 1, 5])
+    for _ in range(50):
+        if req.done.is_set():
+            break
+        eng.step()
+    assert list(req.tokens) == _reference_tokens(params, cfg,
+                                                 [3, 1, 4, 1, 5], 6)
