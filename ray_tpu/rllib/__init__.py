@@ -15,6 +15,9 @@ Algorithms are Tune Trainables, so ``Tuner(PPO, param_space=...)`` works.
 from .a2c import A2C, A2CConfig, A2CLearner
 from .algorithm import Algorithm, AlgorithmConfig
 from .apex_dqn import ApexDQN, ApexDQNConfig, ReplayShard
+from .ars import ARS, ARSConfig
+from .catalog import (ModelSpec, get_model, gru_forward, gru_unroll,
+                      init_gru, register_custom_model)
 from .appo import APPO, APPOConfig, APPOLearner
 from .connectors import (ClipAction, ClipObs, Connector, ConnectorPipeline,
                          FlattenObs, NormalizeObs, UnsquashAction)
@@ -24,16 +27,20 @@ from .env import (BreakoutMini, CartPole, ContextualBandit, Env, Pendulum,
                   VectorEnv, make_env, register_env)
 from .es import ES, ESConfig, ESWorker
 from .impala import IMPALA, IMPALAConfig
-from .offline import (BC, CQL, BCConfig, CQLConfig, collect_dataset,
-                      load_batches, save_batches)
+from .offline import (BC, CQL, MARWIL, BCConfig, CQLConfig, MARWILConfig,
+                      collect_dataset, load_batches, save_batches)
 from .learner import ImpalaLearner, LearnerGroup, PPOLearner, vtrace
 from .multi_agent import (MultiAgentBatch, MultiAgentEnv, MultiAgentPPO,
                           MultiAgentRolloutWorker)
 from .policy import JaxPolicy
+from .r2d2 import (R2D2, R2D2Config, R2D2Learner, R2D2RolloutWorker,
+                   SequenceReplay)
 from .replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 from .ppo import PPO, PPOConfig
 from .rollout_worker import ContinuousRolloutWorker, RolloutWorker
 from .sac import SAC, SACConfig, SACLearner
+from .td3 import (DDPG, TD3, DDPGConfig, TD3Config, TD3Learner,
+                  TD3RolloutWorker)
 from .sample_batch import SampleBatch, compute_gae, concat_samples
 
 __all__ = [
@@ -54,4 +61,13 @@ __all__ = [
     "A2C", "A2CConfig", "A2CLearner", "ApexDQN", "ApexDQNConfig",
     "ReplayShard", "Connector", "ConnectorPipeline", "FlattenObs",
     "NormalizeObs", "ClipObs", "ClipAction", "UnsquashAction",
+    "TD3", "TD3Config", "TD3Learner", "TD3RolloutWorker",
+    "DDPG", "DDPGConfig", "MARWIL", "MARWILConfig", "ARS", "ARSConfig",
+    "R2D2", "R2D2Config", "R2D2Learner", "R2D2RolloutWorker",
+    "SequenceReplay", "ModelSpec", "get_model", "register_custom_model",
+    "init_gru", "gru_forward", "gru_unroll",
 ]
+
+from ray_tpu.usage_stats import record_library_usage as _rlu
+_rlu("rllib")
+del _rlu

@@ -1,0 +1,222 @@
+"""Model catalog: observation/action spec -> encoder + heads.
+
+Ref analog: rllib/models/catalog.py (ModelCatalog.get_model_v2 maps
+space + model_config to a network class, with a custom-model registry)
+— re-designed functionally: a catalog entry is a pure
+``(init_fn, forward_fn)`` pair over a params pytree, so every learner's
+jitted update stays a single XLA program regardless of which encoder the
+catalog picked. Built-ins: "mlp" (the default the gradient algorithms
+use), "conv" (MinAtar-class plane observations -> MXU-friendly NHWC
+convs), "gru" (recurrent encoder for R2D2-style sequence learners).
+
+    init_fn(rng) -> params
+    forward_fn(params, obs[, state]) -> (logits, value[, state])
+
+Custom models register by name, mirroring
+``ModelCatalog.register_custom_model``::
+
+    register_custom_model("my_net", my_init, my_forward)
+    init, fwd = get_model(spec, {"type": "my_net"})
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .models import _ortho, forward as _mlp_forward, init_actor_critic
+
+Params = Dict[str, jnp.ndarray]
+
+_CUSTOM: Dict[str, Tuple[Callable, Callable]] = {}
+
+
+def register_custom_model(name: str, init_fn: Callable,
+                          forward_fn: Callable) -> None:
+    """Register ``(init_fn(rng, spec, config), forward_fn)`` under
+    ``name`` (ref: ModelCatalog.register_custom_model)."""
+    _CUSTOM[name] = (init_fn, forward_fn)
+
+
+class ModelSpec:
+    """What the catalog needs to size a model: flat observation dim (or
+    plane shape for conv) and the discrete action count."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 obs_planes: Optional[Tuple[int, int, int]] = None):
+        self.obs_dim = int(obs_dim)
+        self.num_actions = int(num_actions)
+        # (C, H, W) when observations are flattened feature planes
+        # (BreakoutMini: (4, 10, 10) flattened to 400)
+        self.obs_planes = obs_planes
+
+
+def get_model(spec: ModelSpec, model_config: Optional[dict] = None
+              ) -> Tuple[Callable[[jax.Array], Params], Callable]:
+    """-> (init_fn, forward_fn) for the configured model type.
+
+    forward_fn(params, obs [B, D]) -> (logits [B, A], value [B]) for
+    feedforward types; the "gru" type returns/consumes a carry state
+    (see gru_forward).
+    """
+    cfg = dict(model_config or {})
+    kind = cfg.get("type", "mlp")
+    if kind in _CUSTOM:
+        init, fwd = _CUSTOM[kind]
+        return (lambda rng: init(rng, spec, cfg)), fwd
+    if kind == "mlp":
+        hiddens = tuple(cfg.get("hiddens", (64, 64)))
+        return (lambda rng: init_actor_critic(
+            rng, spec.obs_dim, spec.num_actions, hiddens)), _mlp_forward
+    if kind == "conv":
+        if spec.obs_planes is None:
+            raise ValueError("conv model needs spec.obs_planes=(C, H, W)")
+        return _conv_entry(spec, cfg)
+    if kind == "gru":
+        hidden = int(cfg.get("hidden", 64))
+        embed = tuple(cfg.get("hiddens", (64,)))
+        return (lambda rng: init_gru(rng, spec.obs_dim, spec.num_actions,
+                                     hidden, embed)), gru_forward
+    raise ValueError(f"unknown model type {kind!r}; "
+                     f"registered: {sorted(_CUSTOM)}")
+
+
+# ------------------------------------------------------------------- conv
+
+
+def _conv_entry(spec: ModelSpec, cfg: dict):
+    filters = tuple(cfg.get("conv_filters", (16, 32)))
+    hiddens = tuple(cfg.get("hiddens", (128,)))
+    C, H, W = spec.obs_planes
+
+    def init(rng) -> Params:
+        params: Params = {}
+        keys = jax.random.split(rng, len(filters) + len(hiddens) + 2)
+        cin = C
+        for i, cout in enumerate(filters):
+            # 3x3 convs; He-style scale on the fan-in
+            fan_in = cin * 9
+            params[f"cw{i}"] = jax.random.normal(
+                keys[i], (3, 3, cin, cout)) * jnp.sqrt(2.0 / fan_in)
+            params[f"cb{i}"] = jnp.zeros((cout,))
+            cin = cout
+        flat = cin * H * W  # SAME padding keeps the plane size
+        sizes = [flat, *hiddens]
+        for i in range(len(hiddens)):
+            params[f"w{i}"] = _ortho(keys[len(filters) + i],
+                                     (sizes[i], sizes[i + 1]),
+                                     gain=jnp.sqrt(2.0))
+            params[f"b{i}"] = jnp.zeros((sizes[i + 1],))
+        params["w_pi"] = _ortho(keys[-2], (sizes[-1], spec.num_actions),
+                                gain=0.01)
+        params["b_pi"] = jnp.zeros((spec.num_actions,))
+        params["w_v"] = _ortho(keys[-1], (sizes[-1], 1), gain=1.0)
+        params["b_v"] = jnp.zeros((1,))
+        return params
+
+    def fwd(params: Params, obs: jnp.ndarray):
+        B = obs.shape[0]
+        # flat [B, C*H*W] -> NHWC (TPU conv layout)
+        x = obs.reshape(B, C, H, W).transpose(0, 2, 3, 1)
+        n_conv = sum(1 for k in params if k.startswith("cw"))
+        for i in range(n_conv):
+            x = jax.lax.conv_general_dilated(
+                x, params[f"cw{i}"], window_strides=(1, 1),
+                padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = jax.nn.relu(x + params[f"cb{i}"])
+        x = x.reshape(B, -1)
+        n = sum(1 for k in params
+                if k.startswith("w") and k[1:].isdigit())
+        for i in range(n):
+            x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+        logits = x @ params["w_pi"] + params["b_pi"]
+        value = (x @ params["w_v"] + params["b_v"]).squeeze(-1)
+        return logits, value
+
+    return init, fwd
+
+
+# -------------------------------------------------------------------- gru
+
+
+def init_gru(rng, obs_dim: int, num_actions: int, hidden: int = 64,
+             embed: Sequence[int] = (64,)) -> Params:
+    """Embedding MLP -> GRU cell -> (pi, v) heads. The recurrent model
+    for R2D2-class sequence learners (ref: rllib's use_lstm wrapper,
+    models/torch/recurrent_net.py)."""
+    params: Params = {}
+    keys = jax.random.split(rng, len(embed) + 5)
+    sizes = [obs_dim, *embed]
+    for i in range(len(embed)):
+        params[f"w{i}"] = _ortho(keys[i], (sizes[i], sizes[i + 1]),
+                                 gain=jnp.sqrt(2.0))
+        params[f"b{i}"] = jnp.zeros((sizes[i + 1],))
+    E = sizes[-1]
+    # fused GRU weights: [E, 3H] input and [H, 3H] recurrent (r, z, n)
+    params["gru_wi"] = _ortho(keys[-4], (E, 3 * hidden), gain=1.0)
+    params["gru_wh"] = _ortho(keys[-3], (hidden, 3 * hidden), gain=1.0)
+    params["gru_b"] = jnp.zeros((3 * hidden,))
+    params["w_pi"] = _ortho(keys[-2], (hidden, num_actions), gain=0.01)
+    params["b_pi"] = jnp.zeros((num_actions,))
+    params["w_v"] = _ortho(keys[-1], (hidden, 1), gain=1.0)
+    params["b_v"] = jnp.zeros((1,))
+    return params
+
+
+def gru_cell(params: Params, x: jnp.ndarray, h: jnp.ndarray
+             ) -> jnp.ndarray:
+    """One GRU step: x [B, E], h [B, H] -> new h [B, H]."""
+    H = h.shape[-1]
+    gi = x @ params["gru_wi"] + params["gru_b"]
+    gh = h @ params["gru_wh"]
+    r = jax.nn.sigmoid(gi[:, :H] + gh[:, :H])
+    z = jax.nn.sigmoid(gi[:, H:2 * H] + gh[:, H:2 * H])
+    n = jnp.tanh(gi[:, 2 * H:] + r * gh[:, 2 * H:])
+    return (1.0 - z) * n + z * h
+
+
+def _embed(params: Params, obs: jnp.ndarray) -> jnp.ndarray:
+    n = sum(1 for k in params if k.startswith("w") and k[1:].isdigit())
+    x = obs
+    for i in range(n):
+        x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+    return x
+
+
+def gru_forward(params: Params, obs: jnp.ndarray,
+                state: Optional[jnp.ndarray] = None):
+    """obs [B, D] (one step) -> (logits [B, A], value [B], new state).
+    ``state`` [B, H] defaults to zeros (episode start)."""
+    H = params["gru_wh"].shape[0]
+    if state is None:
+        state = jnp.zeros((obs.shape[0], H), obs.dtype)
+    h = gru_cell(params, _embed(params, obs), state)
+    logits = h @ params["w_pi"] + params["b_pi"]
+    value = (h @ params["w_v"] + params["b_v"]).squeeze(-1)
+    return logits, value, h
+
+
+def gru_unroll(params: Params, obs_seq: jnp.ndarray,
+               h0: jnp.ndarray, reset: Optional[jnp.ndarray] = None):
+    """Unroll over time with lax.scan: obs_seq [T, B, D], h0 [B, H],
+    reset [T, B] bool (True clears the carry BEFORE consuming step t —
+    episode boundaries inside a training sequence); -> (logits
+    [T, B, A], values [T, B], h_final [B, H])."""
+
+    def step(h, inp):
+        if reset is None:
+            x = inp
+        else:
+            x, r = inp
+            h = jnp.where(r[:, None], jnp.zeros_like(h), h)
+        h = gru_cell(params, _embed(params, x), h)
+        logits = h @ params["w_pi"] + params["b_pi"]
+        value = (h @ params["w_v"] + params["b_v"]).squeeze(-1)
+        return h, (logits, value)
+
+    xs = obs_seq if reset is None else (obs_seq, reset)
+    h_final, (logits, values) = jax.lax.scan(step, h0, xs)
+    return logits, values, h_final

@@ -314,3 +314,100 @@ class CQL(_OfflineAlgorithm):
             self.params = {k: jnp.asarray(v)
                            for k, v in checkpoint["weights"].items()}
             self.target_params = jax.tree.map(jnp.copy, self.params)
+
+
+class MARWILConfig(OfflineConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or MARWIL)
+        self.beta = 1.0            # 0 => exact BC (ref: marwil.py beta)
+        self.vf_coeff = 1.0
+        self.ma_adv_momentum = 1e-2  # moving-average advantage norm rate
+
+    def offline_data(self, *, input_path: str) -> "MARWILConfig":
+        self.input_path = input_path
+        return self
+
+
+class MARWIL(_OfflineAlgorithm):
+    """Monotonic advantage re-weighted imitation learning (Wang et al.
+    2018). Ref analog: rllib/algorithms/marwil/marwil.py — BC whose
+    log-likelihood is weighted by exp(beta * normalized advantage), with
+    a critic supplying the baseline. Advantages here are one-step TD
+    residuals r + gamma*V(s') - V(s) against the jointly-trained value
+    head (the logged .npz shards carry transitions, not whole episodes,
+    so Monte-Carlo returns are not reconstructible), normalized by the
+    reference's moving-average-of-squares estimate.
+    """
+
+    _config_cls = MARWILConfig
+
+    def _make_learner(self, cfg):
+        self.params = init_actor_critic(
+            jax.random.key(cfg.seed), self._obs_dim, self._num_actions,
+            cfg.model_hiddens)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        # moving average of squared advantages: the exp() weight is
+        # exp(beta * adv / sqrt(ma)) so beta stays scale-free
+        self.ma_adv_sq = jnp.asarray(1.0)
+        beta, vf_coeff = cfg.beta, cfg.vf_coeff
+        ent_coeff, gamma = cfg.entropy_coeff, cfg.gamma
+        momentum = cfg.ma_adv_momentum
+
+        def loss_fn(params, batch, ma_adv_sq):
+            logits, values = forward(params, batch[SB.OBS])
+            _, v_next = forward(params, batch[SB.NEXT_OBS])
+            not_done = 1.0 - batch[SB.DONES].astype(jnp.float32)
+            target = jax.lax.stop_gradient(
+                batch[SB.REWARDS] + gamma * not_done * v_next)
+            adv = target - values
+            vf_loss = jnp.mean(adv ** 2)
+            ma = ma_adv_sq + momentum * (
+                jnp.mean(jax.lax.stop_gradient(adv) ** 2) - ma_adv_sq)
+            w = jnp.exp(jnp.clip(
+                beta * jax.lax.stop_gradient(adv) / jnp.sqrt(ma + 1e-8),
+                -10.0, 10.0))
+            logp = logp_of(logits, batch[SB.ACTIONS])
+            ent = entropy_of(logits).mean()
+            policy_loss = -jnp.mean(w * logp)
+            loss = policy_loss + vf_coeff * vf_loss - ent_coeff * ent
+            return loss, ({"policy_loss": policy_loss, "vf_loss": vf_loss,
+                           "adv_weight_mean": w.mean(), "entropy": ent},
+                          ma)
+
+        @jax.jit
+        def train_step(params, opt_state, ma_adv_sq, batch):
+            (loss, (metrics, ma)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch, ma_adv_sq)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, ma, metrics
+
+        self._train_step = train_step
+
+    def training_step(self) -> dict:
+        metrics = {}
+        for _ in range(self.algo_config.num_updates_per_iter):
+            mb = self._minibatch()
+            self.params, self.opt_state, self.ma_adv_sq, metrics = \
+                self._train_step(
+                    self.params, self.opt_state, self.ma_adv_sq,
+                    {k: jnp.asarray(v) for k, v in mb.items()
+                     if k in (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.DONES,
+                              SB.NEXT_OBS)})
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_policy_weights(self):
+        return {k: np.asarray(v) for k, v in self.params.items()}
+
+    def save_checkpoint(self):
+        return {"weights": self.get_policy_weights(),
+                "ma_adv_sq": float(self.ma_adv_sq)}
+
+    def load_checkpoint(self, checkpoint):
+        if checkpoint:
+            self.params = {k: jnp.asarray(v)
+                           for k, v in checkpoint["weights"].items()}
+            self.ma_adv_sq = jnp.asarray(
+                checkpoint.get("ma_adv_sq", 1.0))

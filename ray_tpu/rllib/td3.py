@@ -1,0 +1,294 @@
+"""TD3 + DDPG: deterministic-policy off-policy continuous control.
+
+Ref analogs: rllib/algorithms/ddpg/ddpg.py (DDPGConfig: actor/critic lr,
+tau, target-noise knobs, the DQN-style sample->store->replay->learn
+training_step) and rllib/algorithms/td3/td3.py (TD3 = DDPG config preset
+with twin_q, policy_delay=2, smoothed target actions — Fujimoto et al.
+2018). TPU-first re-design: the critic regression (twin-min smoothed
+Bellman target) and the delayed actor ascent are each ONE jitted XLA
+program over a contiguous replay batch; rollouts stay CPU actors.
+
+The actor reuses the squashed-Gaussian parameter layout (mu head only is
+trained) so worker-side weight sync lands in the same
+``SquashedGaussianPolicy`` every continuous algorithm here uses — TD3's
+exploration is mean action + numpy Gaussian noise, not the policy's own
+(untrained) log_std head.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+
+from . import sample_batch as SB
+from .algorithm import Algorithm, AlgorithmConfig
+from .models import (gaussian_forward, init_gaussian_actor, init_q_net,
+                     q_forward)
+from .replay_buffers import ReplayBuffer
+from .rollout_worker import ContinuousRolloutWorker, _collect_transitions
+from .sample_batch import SampleBatch, concat_samples
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or TD3)
+        self.env = "Pendulum-v1"
+        self.lr = 1e-3                   # actor
+        self.critic_lr = 1e-3
+        self.train_batch_size = 128
+        self.replay_buffer_capacity = 100_000
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.tau = 0.005                 # Polyak target blend
+        self.twin_q = True
+        self.policy_delay = 2
+        self.target_noise = 0.2          # smoothing noise on target action
+        self.target_noise_clip = 0.5
+        self.explore_noise = 0.1         # rollout-side N(0, s*scale)
+        self.num_updates_per_iter = 64
+        self.warmup_random_action_prob = 1.0
+
+
+class DDPGConfig(TD3Config):
+    """DDPG = TD3 minus its three fixes (ref: td3.py presets inverted)."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DDPG)
+        self.twin_q = False
+        self.policy_delay = 1
+        self.target_noise = 0.0
+
+
+class TD3Learner:
+    """Deterministic actor + (twin) critics + Polyak targets.
+
+    Two jitted programs: ``critic_step`` every update, ``actor_step``
+    every ``policy_delay`` updates (static Python cadence, so each stays
+    a single compiled program with no traced branching)."""
+
+    def __init__(self, obs_dim: int, action_dim: int, *, actor_lr: float,
+                 critic_lr: float, gamma: float, tau: float,
+                 action_scale, action_shift, twin_q: bool,
+                 target_noise: float, target_noise_clip: float,
+                 hiddens=(64, 64), seed: int = 0):
+        k = jax.random.split(jax.random.key(seed), 3)
+        self.twin_q = bool(twin_q)
+        self.state = {
+            "actor": init_gaussian_actor(k[0], obs_dim, action_dim,
+                                         hiddens),
+            "q1": init_q_net(k[1], obs_dim, action_dim, hiddens),
+            "q2": init_q_net(k[2], obs_dim, action_dim, hiddens),
+        }
+        self.state["t_actor"] = jax.tree.map(jnp.copy, self.state["actor"])
+        self.state["tq1"] = jax.tree.map(jnp.copy, self.state["q1"])
+        self.state["tq2"] = jax.tree.map(jnp.copy, self.state["q2"])
+        self._actor_opt = optax.adam(actor_lr)
+        self._critic_opt = optax.adam(critic_lr)
+        self.opt_state = {
+            "actor": self._actor_opt.init(self.state["actor"]),
+            "critic": self._critic_opt.init(
+                (self.state["q1"], self.state["q2"])),
+        }
+        self._rng = jax.random.key(seed + 1)
+        scale = jnp.asarray(action_scale, jnp.float32)
+        shift = jnp.asarray(action_shift, jnp.float32)
+        lo, hi = shift - scale, shift + scale
+
+        def act(actor, obs):
+            mu, _ = gaussian_forward(actor, obs)
+            return shift + scale * jnp.tanh(mu)
+
+        def critic_loss(qs, state, batch, rng):
+            a_next = act(state["t_actor"], batch[SB.NEXT_OBS])
+            if target_noise > 0.0:
+                eps = jnp.clip(
+                    target_noise * scale
+                    * jax.random.normal(rng, a_next.shape),
+                    -target_noise_clip * scale, target_noise_clip * scale)
+                a_next = jnp.clip(a_next + eps, lo, hi)
+            tq = q_forward(state["tq1"], batch[SB.NEXT_OBS], a_next)
+            if self.twin_q:
+                tq = jnp.minimum(
+                    tq, q_forward(state["tq2"], batch[SB.NEXT_OBS],
+                                  a_next))
+            not_done = 1.0 - batch[SB.DONES].astype(jnp.float32)
+            target = jax.lax.stop_gradient(
+                batch[SB.REWARDS] + gamma * not_done * tq)
+            q1p, q2p = qs
+            e1 = q_forward(q1p, batch[SB.OBS], batch[SB.ACTIONS]) - target
+            loss = jnp.mean(e1 ** 2)
+            if self.twin_q:
+                e2 = q_forward(q2p, batch[SB.OBS],
+                               batch[SB.ACTIONS]) - target
+                loss = loss + jnp.mean(e2 ** 2)
+            return loss
+
+        @jax.jit
+        def critic_step(state, opt_state, batch, rng):
+            loss, grads = jax.value_and_grad(critic_loss)(
+                (state["q1"], state["q2"]), state, batch, rng)
+            upd, copt = self._critic_opt.update(
+                grads, opt_state["critic"], (state["q1"], state["q2"]))
+            q1, q2 = optax.apply_updates((state["q1"], state["q2"]), upd)
+            state = dict(state, q1=q1, q2=q2)
+            return state, dict(opt_state, critic=copt), loss
+
+        def actor_loss(actor, state, batch):
+            a = act(actor, batch[SB.OBS])
+            return -jnp.mean(q_forward(state["q1"], batch[SB.OBS], a))
+
+        @jax.jit
+        def actor_step(state, opt_state, batch):
+            loss, grads = jax.value_and_grad(actor_loss)(
+                state["actor"], state, batch)
+            upd, aopt = self._actor_opt.update(
+                grads, opt_state["actor"], state["actor"])
+            actor = optax.apply_updates(state["actor"], upd)
+            blend = lambda t, s: jax.tree.map(  # noqa: E731
+                lambda a, b: (1 - tau) * a + tau * b, t, s)
+            state = dict(state, actor=actor,
+                         t_actor=blend(state["t_actor"], actor),
+                         tq1=blend(state["tq1"], state["q1"]),
+                         tq2=blend(state["tq2"], state["q2"]))
+            return state, dict(opt_state, actor=aopt), loss
+
+        self._critic_step = critic_step
+        self._actor_step = actor_step
+
+    def update(self, batch: SampleBatch, *, do_actor: bool) -> dict:
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k in (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.DONES,
+                       SB.NEXT_OBS)}
+        self._rng, sub = jax.random.split(self._rng)
+        self.state, self.opt_state, closs = self._critic_step(
+            self.state, self.opt_state, jb, sub)
+        out = {"critic_loss": float(closs)}
+        if do_actor:
+            self.state, self.opt_state, aloss = self._actor_step(
+                self.state, self.opt_state, jb)
+            out["actor_loss"] = float(aloss)
+        return out
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        # worker policies are SquashedGaussianPolicy — same param layout
+        return {k: np.asarray(v) for k, v in self.state["actor"].items()}
+
+    def set_weights(self, weights):
+        self.state["actor"] = {k: jnp.asarray(v)
+                               for k, v in weights.items()}
+
+    def full_state(self) -> dict:
+        return {"state": jax.tree.map(np.asarray, self.state),
+                "opt_state": jax.tree.map(np.asarray, self.opt_state),
+                "rng": np.asarray(jax.random.key_data(self._rng))}
+
+    def load_full_state(self, payload: dict):
+        self.state = jax.tree.map(jnp.asarray, payload["state"])
+        self.opt_state = jax.tree.map(jnp.asarray, payload["opt_state"])
+        self._rng = jax.random.wrap_key_data(jnp.asarray(payload["rng"]))
+
+
+class TD3RolloutWorker(ContinuousRolloutWorker):
+    """Deterministic action + N(0, noise*scale), clipped to bounds
+    (ref: DDPG's GaussianNoise exploration, rllib/utils/exploration/
+    gaussian_noise.py)."""
+
+    def sample_transitions(self, epsilon: float = 0.0,
+                           noise: float = 0.1) -> SampleBatch:
+        N, A = self.vec.num_envs, self.vec.action_dim
+        env0 = self.vec.envs[0]
+        lo, hi = env0.action_low, env0.action_high
+        sigma = noise * (hi - lo) / 2.0
+
+        def select(obs):
+            if epsilon >= 1.0:  # pure warmup
+                return self._rng.uniform(
+                    lo, hi, size=(N, A)).astype(np.float32)
+            actions, _ = self.policy.compute_actions(obs, explore=False)
+            actions = actions + sigma * self._rng.standard_normal(
+                (N, A)).astype(np.float32)
+            return np.clip(actions, lo, hi).astype(np.float32)
+
+        return _collect_transitions(self.vec, self.rollout_len, select,
+                                    (A,), np.float32, self.conn)
+
+
+class TD3(Algorithm):
+    _config_cls = TD3Config
+    _worker_cls = TD3RolloutWorker
+
+    def _make_learner_factory(self, cfg, obs_dim, action_dim):
+        probe = self._probe_env
+        scale = (probe.action_high - probe.action_low) / 2.0
+        shift = (probe.action_high + probe.action_low) / 2.0
+
+        def make():
+            return TD3Learner(
+                obs_dim, action_dim, actor_lr=cfg.lr,
+                critic_lr=cfg.critic_lr, gamma=cfg.gamma, tau=cfg.tau,
+                action_scale=scale, action_shift=shift,
+                twin_q=cfg.twin_q, target_noise=cfg.target_noise,
+                target_noise_clip=cfg.target_noise_clip,
+                hiddens=cfg.model_hiddens, seed=cfg.seed)
+
+        return make
+
+    def setup(self, config):
+        super().setup(config)
+        cfg = self.algo_config
+        self.replay = ReplayBuffer(cfg.replay_buffer_capacity,
+                                   seed=cfg.seed)
+        self._updates = 0
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        warming_up = (self.replay.num_added <
+                      cfg.num_steps_sampled_before_learning_starts)
+        eps = cfg.warmup_random_action_prob if warming_up else 0.0
+        batches = ray_tpu.get(
+            [w.sample_transitions.remote(eps, cfg.explore_noise)
+             for w in self.workers], timeout=300)
+        fresh = concat_samples(batches)
+        self.replay.add(fresh)
+        self._num_env_steps += fresh.count
+
+        metrics = {"env_steps_this_iter": fresh.count,
+                   "replay_size": len(self.replay)}
+        learner = self.learners.local
+        if self.replay.num_added >= \
+                cfg.num_steps_sampled_before_learning_starts:
+            last = {}
+            for _ in range(cfg.num_updates_per_iter):
+                sample = self.replay.sample(cfg.train_batch_size)
+                if sample is None:
+                    break
+                self._updates += 1
+                last = learner.update(
+                    sample,
+                    do_actor=self._updates % cfg.policy_delay == 0)
+            metrics.update(last)
+            self._sync_weights()
+        return metrics
+
+    def save_checkpoint(self):
+        return {"td3_state": self.learners.local.full_state(),
+                "num_env_steps": self._num_env_steps,
+                "updates": self._updates}
+
+    def load_checkpoint(self, checkpoint):
+        if checkpoint and "td3_state" in checkpoint:
+            self.learners.local.load_full_state(checkpoint["td3_state"])
+            self._num_env_steps = checkpoint.get("num_env_steps", 0)
+            self._updates = checkpoint.get("updates", 0)
+            self._sync_weights()
+        else:
+            super().load_checkpoint(checkpoint)
+
+
+class DDPG(TD3):
+    _config_cls = DDPGConfig

@@ -48,7 +48,8 @@ SLOW_MODULES = {
     "test_models", "test_encoder", "test_generate", "test_engine",
     "test_parallel", "test_train", "test_tune", "test_ops",
     "test_rllib", "test_rllib_breadth", "test_rllib_sac",
-    "test_rllib_connectors",
+    "test_rllib_connectors", "test_rllib_continuous",
+    "test_rllib_catalog",
     "test_serve_depth", "test_data_breadth",
 }
 

@@ -1,0 +1,148 @@
+"""Model catalog + R2D2 recurrent replay learner.
+
+Ref analogs: rllib/models/tests/test_models.py (catalog resolution,
+custom-model registry) and rllib/algorithms/r2d2/tests/test_r2d2.py
+(recurrent Q-learning smoke), sized for one host per SURVEY.md §4.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    info = ray_tpu.init(num_cpus=4, num_tpus=0, ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+class TestCatalog:
+    def test_mlp_default(self):
+        from ray_tpu.rllib import ModelSpec, get_model
+
+        init, fwd = get_model(ModelSpec(4, 2))
+        params = init(jax.random.key(0))
+        logits, value = fwd(params, jnp.zeros((3, 4)))
+        assert logits.shape == (3, 2) and value.shape == (3,)
+
+    def test_conv_for_plane_observations(self):
+        from ray_tpu.rllib import ModelSpec, get_model
+
+        spec = ModelSpec(400, 3, obs_planes=(4, 10, 10))
+        init, fwd = get_model(spec, {"type": "conv",
+                                     "conv_filters": (8, 16)})
+        params = init(jax.random.key(0))
+        logits, value = fwd(params, jnp.zeros((5, 400)))
+        assert logits.shape == (5, 3) and value.shape == (5,)
+        # conv params exist and the net is sensitive to spatial structure
+        assert any(k.startswith("cw") for k in params)
+        obs = np.zeros((1, 400), np.float32)
+        obs2 = obs.copy()
+        obs2[0, 37] = 1.0  # one cell lights up
+        l1, _ = fwd(params, jnp.asarray(obs))
+        l2, _ = fwd(params, jnp.asarray(obs2))
+        assert not np.allclose(np.asarray(l1), np.asarray(l2))
+
+    def test_custom_model_registry(self):
+        from ray_tpu.rllib import ModelSpec, get_model, \
+            register_custom_model
+
+        def my_init(rng, spec, cfg):
+            return {"w": jnp.ones((spec.obs_dim, spec.num_actions))}
+
+        def my_fwd(params, obs):
+            logits = obs @ params["w"]
+            return logits, logits.sum(-1)
+
+        register_custom_model("test_linear", my_init, my_fwd)
+        init, fwd = get_model(ModelSpec(3, 2), {"type": "test_linear"})
+        logits, _ = fwd(init(jax.random.key(0)), jnp.ones((1, 3)))
+        np.testing.assert_allclose(np.asarray(logits), [[3.0, 3.0]])
+
+    def test_unknown_type_raises(self):
+        from ray_tpu.rllib import ModelSpec, get_model
+
+        with pytest.raises(ValueError, match="unknown model type"):
+            get_model(ModelSpec(3, 2), {"type": "nope"})
+
+
+class TestGRU:
+    def test_unroll_matches_stepwise(self):
+        from ray_tpu.rllib import gru_forward, gru_unroll, init_gru
+
+        params = init_gru(jax.random.key(0), 4, 2, hidden=8)
+        T, B = 5, 3
+        obs = jax.random.normal(jax.random.key(1), (T, B, 4))
+        h = jnp.zeros((B, 8))
+        step_logits = []
+        for t in range(T):
+            lt, _, h = gru_forward(params, obs[t], h)
+            step_logits.append(lt)
+        logits, _, h_final = gru_unroll(params, obs, jnp.zeros((B, 8)))
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(jnp.stack(step_logits)),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(h), np.asarray(h_final),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_reset_clears_carry(self):
+        from ray_tpu.rllib import gru_unroll, init_gru
+
+        params = init_gru(jax.random.key(0), 4, 2, hidden=8)
+        T, B = 4, 1
+        obs = jax.random.normal(jax.random.key(1), (T, B, 4))
+        # reset at t=2: steps 2..3 must equal a fresh unroll of obs[2:]
+        reset = jnp.asarray([[False], [False], [True], [False]])
+        logits_r, _, _ = gru_unroll(params, obs, jnp.zeros((B, 8)), reset)
+        logits_f, _, _ = gru_unroll(params, obs[2:], jnp.zeros((B, 8)))
+        np.testing.assert_allclose(np.asarray(logits_r[2:]),
+                                   np.asarray(logits_f),
+                                   rtol=2e-5, atol=2e-5)
+
+
+class TestR2D2:
+    def test_learner_regresses_fixed_target(self):
+        from ray_tpu.rllib import R2D2Learner
+
+        l = R2D2Learner(3, 2, lr=1e-2, gamma=0.9, burn_in=2, hidden=8,
+                        seed=0)
+        rng = np.random.default_rng(0)
+        B, T = 16, 10
+        batch = {
+            "obs": rng.normal(size=(B, T, 3)).astype(np.float32),
+            "actions": rng.integers(0, 2, (B, T)),
+            "rewards": np.full((B, T), 1.0, np.float32),
+            "dones": np.ones((B, T), np.bool_),  # target exactly r
+            "reset": np.zeros((B, T), np.bool_),
+            "h0": np.zeros((B, 8), np.float32),
+        }
+        losses = [l.update(batch)["loss"] for _ in range(150)]
+        assert losses[-1] < losses[0] * 0.2
+
+    def test_r2d2_learns_cartpole(self, rt):
+        """The memoryless-env smoke: with full observability the GRU
+        must still reach DQN-class CartPole reward (the reference's
+        r2d2 tests use stateless CartPole the same way)."""
+        from ray_tpu.rllib import R2D2Config
+
+        algo = (R2D2Config().environment("CartPole-v1")
+                .rollouts(num_rollout_workers=1, num_envs_per_worker=8)
+                .training(train_batch_size=32, num_updates_per_iter=24,
+                          num_steps_sampled_before_learning_starts=500,
+                          seq_len=16, burn_in=4, epsilon_timesteps=3000,
+                          target_network_update_freq=400)
+                .debugging(seed=0)).build()
+        best = 0.0
+        for _ in range(100):
+            r = algo.train()
+            best = max(best, r.get("episode_reward_mean", 0.0))
+            if best > 100:
+                break
+        algo.cleanup()
+        # random play scores ~20; 100+ demonstrates recurrent Q-learning
+        # (full convergence needs more updates than a CI budget allows)
+        assert best > 100, f"R2D2 failed to learn CartPole: best {best}"

@@ -1,0 +1,209 @@
+"""TD3/DDPG, MARWIL, ARS — round-5 algorithm-family breadth.
+
+Analogs of the reference's per-algorithm tests
+(rllib/algorithms/td3/tests/test_td3.py, ddpg/tests, marwil/tests,
+ars/tests) sized for one host per SURVEY.md §4.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    info = ray_tpu.init(num_cpus=4, num_tpus=0, ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+class TestTD3Learner:
+    def _batch(self, n=256, done=True):
+        from ray_tpu.rllib import sample_batch as SB
+        from ray_tpu.rllib.sample_batch import SampleBatch
+
+        rng = np.random.default_rng(0)
+        return SampleBatch({
+            SB.OBS: rng.normal(size=(n, 3)).astype(np.float32),
+            SB.ACTIONS: rng.uniform(-2, 2, (n, 1)).astype(np.float32),
+            SB.REWARDS: np.full(n, 1.0, np.float32),
+            SB.DONES: np.full(n, done, np.bool_),
+            SB.NEXT_OBS: rng.normal(size=(n, 3)).astype(np.float32),
+        })
+
+    def test_critic_regresses_to_fixed_target(self):
+        from ray_tpu.rllib import TD3Learner
+
+        l = TD3Learner(3, 1, actor_lr=1e-3, critic_lr=1e-2, gamma=0.9,
+                       tau=0.01, action_scale=2.0, action_shift=0.0,
+                       twin_q=True, target_noise=0.2,
+                       target_noise_clip=0.5, seed=0)
+        batch = self._batch(done=True)  # all-done => target exactly r=1
+        losses = [l.update(batch, do_actor=(i % 2 == 0))["critic_loss"]
+                  for i in range(200)]
+        assert losses[-1] < losses[0] * 0.2
+
+    def test_ddpg_single_q_mode(self):
+        from ray_tpu.rllib import TD3Learner
+
+        l = TD3Learner(3, 1, actor_lr=1e-3, critic_lr=1e-2, gamma=0.9,
+                       tau=0.01, action_scale=2.0, action_shift=0.0,
+                       twin_q=False, target_noise=0.0,
+                       target_noise_clip=0.0, seed=0)
+        out = l.update(self._batch(), do_actor=True)
+        assert np.isfinite(out["critic_loss"])
+        assert np.isfinite(out["actor_loss"])
+
+    def test_delayed_actor_and_target_blend(self):
+        import jax
+
+        from ray_tpu.rllib import TD3Learner
+
+        l = TD3Learner(3, 1, actor_lr=1e-2, critic_lr=1e-2, gamma=0.99,
+                       tau=0.5, action_scale=2.0, action_shift=0.0,
+                       twin_q=True, target_noise=0.2,
+                       target_noise_clip=0.5, seed=0)
+        t0 = jax.tree.map(np.asarray, l.state["t_actor"])
+        a0 = jax.tree.map(np.asarray, l.state["actor"])
+        l.update(self._batch(), do_actor=False)
+        # critic-only update: actor and its target untouched
+        for k in a0:
+            np.testing.assert_array_equal(a0[k],
+                                          np.asarray(l.state["actor"][k]))
+            np.testing.assert_array_equal(
+                t0[k], np.asarray(l.state["t_actor"][k]))
+        l.update(self._batch(), do_actor=True)
+        # actor step moves the actor AND Polyak-blends targets toward it
+        moved = any(
+            not np.allclose(a0[k], np.asarray(l.state["actor"][k]))
+            for k in a0)
+        blended = any(
+            not np.allclose(t0[k], np.asarray(l.state["t_actor"][k]))
+            for k in t0)
+        assert moved and blended
+
+    def test_weight_sync_layout_matches_worker_policy(self):
+        from ray_tpu.rllib import TD3Learner
+        from ray_tpu.rllib.policy import SquashedGaussianPolicy
+
+        l = TD3Learner(3, 1, actor_lr=1e-3, critic_lr=1e-3, gamma=0.99,
+                       tau=0.01, action_scale=2.0, action_shift=0.0,
+                       twin_q=True, target_noise=0.2,
+                       target_noise_clip=0.5, seed=0)
+        pol = SquashedGaussianPolicy(3, 1, action_scale=2.0, seed=1)
+        pol.set_weights(l.get_weights())  # must not raise
+        a, _ = pol.compute_actions(np.zeros((2, 3), np.float32),
+                                   explore=False)
+        assert a.shape == (2, 1) and np.all(np.abs(a) <= 2.0)
+
+
+class TestTD3EndToEnd:
+    def test_td3_learns_pendulum(self, rt):
+        """Random play on Pendulum scores ~ -1200; the same -900 bar the
+        SAC end-to-end test uses (seed-noise-proof, mirrors the
+        reference's pendulum-ddpg stop criterion)."""
+        from ray_tpu.rllib import TD3Config
+
+        algo = (TD3Config().environment("Pendulum-v1")
+                .rollouts(num_rollout_workers=1, num_envs_per_worker=8,
+                          rollout_fragment_length=32)
+                .training(train_batch_size=128, num_updates_per_iter=64,
+                          num_steps_sampled_before_learning_starts=512,
+                          explore_noise=0.2)
+                .debugging(seed=3)).build()
+        best = -1e9
+        # TD3's deterministic policy needs ~2x SAC's samples on Pendulum
+        # (no entropy bonus); the measured curve crosses -900 near
+        # iteration 75 and reaches ~ -340 by 100
+        for _ in range(90):
+            r = algo.train()
+            best = max(best, r.get("episode_reward_mean", -1e9))
+            if best > -900:
+                break
+        algo.cleanup()
+        assert best > -900, f"TD3 failed to learn: best {best}"
+
+
+class TestMARWIL:
+    def test_marwil_beats_bc_on_mixed_data(self, rt, tmp_path):
+        """Dataset = mostly-random behavior with occasional good
+        episodes: plain BC clones the (bad) average policy; MARWIL's
+        advantage weighting must upweight the good actions and score
+        better in-env."""
+        from ray_tpu.rllib import (BCConfig, MARWILConfig,
+                                   collect_dataset)
+
+        path = str(tmp_path / "mixed")
+        collect_dataset("CartPole-v1", path, num_steps=6144,
+                        epsilon=0.7, seed=0)
+
+        def final_reward(config):
+            algo = config.build()
+            last = 0.0
+            for _ in range(8):
+                last = algo.train()["episode_reward_mean"]
+            algo.cleanup()
+            return last
+
+        marwil = final_reward(
+            MARWILConfig().environment("CartPole-v1")
+            .offline_data(input_path=path)
+            .training(beta=2.0, num_updates_per_iter=64,
+                      train_batch_size=256))
+        bc = final_reward(
+            BCConfig().environment("CartPole-v1")
+            .offline_data(input_path=path)
+            .training(num_updates_per_iter=64, train_batch_size=256))
+        # advantage weighting should not be WORSE than cloning and
+        # usually clears it; the hard bar is against the random baseline
+        assert marwil > 25.0, f"MARWIL below random-ish play: {marwil}"
+        assert marwil >= bc * 0.8, f"MARWIL {marwil} << BC {bc}"
+
+    def test_beta_zero_is_bc(self, rt, tmp_path):
+        """beta=0 collapses the weight to exp(0)=1 — the reference
+        documents MARWIL(beta=0) == BC."""
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib import MARWILConfig, collect_dataset
+
+        path = str(tmp_path / "data")
+        collect_dataset("CartPole-v1", path, num_steps=2048, seed=1)
+        algo = (MARWILConfig().environment("CartPole-v1")
+                .offline_data(input_path=path)
+                .training(beta=0.0, num_updates_per_iter=8)).build()
+        m = algo.training_step()
+        assert abs(m["adv_weight_mean"] - 1.0) < 1e-5
+        algo.cleanup()
+
+
+class TestARS:
+    def test_ars_learns_cartpole(self, rt):
+        from ray_tpu.rllib import ARSConfig
+
+        algo = (ARSConfig().environment("CartPole-v1")
+                .training(sigma=0.1, lr=0.05, perturbations_per_step=16,
+                          top_directions=8)
+                .debugging(seed=0)).build()
+        best = 0.0
+        for _ in range(25):
+            r = algo.train()
+            best = max(best, r["episode_reward_mean"])
+            if best > 150:
+                break
+        algo.cleanup()
+        assert best > 150, f"ARS failed to learn CartPole: best {best}"
+
+    def test_checkpoint_roundtrip(self, rt):
+        from ray_tpu.rllib import ARSConfig
+
+        algo = (ARSConfig().environment("CartPole-v1")
+                .training(perturbations_per_step=4, top_directions=2)
+                .debugging(seed=0)).build()
+        algo.train()
+        ckpt = algo.save_checkpoint()
+        flat0 = np.array(algo._flat)
+        algo.train()
+        algo.load_checkpoint(ckpt)
+        np.testing.assert_array_equal(algo._flat, flat0)
+        algo.cleanup()
