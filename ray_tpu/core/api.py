@@ -101,6 +101,10 @@ def init(*, num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
         if session_dir is None:
             session_dir = f"/tmp/ray_tpu/session_{session_name}"
         os.makedirs(session_dir, exist_ok=True)
+        from ray_tpu import usage_stats as _usage
+
+        _usage.print_usage_stats_notice()
+        _usage.record_library_usage("core")
         head = Head(session_dir, session_name)
         head.add_node(num_cpus=num_cpus, num_tpus=num_tpus,
                       object_store_memory=object_store_memory,
@@ -159,6 +163,12 @@ def shutdown():
     with _init_lock:
         ctx = get_context_if_exists()
         if ctx is not None:
+            try:  # usage report file sink (ref: usage_lib's reporter)
+                from ray_tpu import usage_stats as _usage
+
+                _usage.write_report(ctx.session_dir)
+            except Exception:
+                pass
             try:
                 ctx.shutdown()
             finally:

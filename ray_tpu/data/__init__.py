@@ -44,3 +44,7 @@ __all__ = [
     "read_binary_files", "read_datasource", "read_images",
     "read_tfrecords", "read_webdataset",
 ]
+
+from ray_tpu.usage_stats import record_library_usage as _rlu
+_rlu("data")
+del _rlu

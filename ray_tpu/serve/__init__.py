@@ -44,3 +44,7 @@ __all__ = [
     "AutoscalingConfig",
     "DeploymentConfig", "HTTPOptions",
 ]
+
+from ray_tpu.usage_stats import record_library_usage as _rlu
+_rlu("serve")
+del _rlu

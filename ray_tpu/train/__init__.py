@@ -45,3 +45,7 @@ __all__ = [
     "report", "get_checkpoint", "get_context", "get_dataset_shard",
     "get_world_rank", "get_world_size", "get_local_rank", "TrainContext",
 ]
+
+from ray_tpu.usage_stats import record_library_usage as _rlu
+_rlu("train")
+del _rlu

@@ -49,3 +49,7 @@ __all__ = [
     "choice", "uniform", "loguniform", "quniform", "randint", "qrandint",
     "grid_search", "sample_from",
 ]
+
+from ray_tpu.usage_stats import record_library_usage as _rlu
+_rlu("tune")
+del _rlu

@@ -13,3 +13,7 @@ from ray_tpu.workflow.execution import (WorkflowStatus, delete, get_output,
 
 __all__ = ["run", "run_async", "resume", "get_status", "get_output",
            "list_all", "delete", "init", "WorkflowStatus"]
+
+from ray_tpu.usage_stats import record_library_usage as _rlu
+_rlu("workflow")
+del _rlu

@@ -1,0 +1,156 @@
+"""Ecosystem shims + usage stats: dask scheduler, spark cluster seam,
+usage-stats collection.
+
+Ref analogs: python/ray/util/dask/tests, python/ray/util/spark/tests,
+python/ray/tests/test_usage_stats.py — sized for one host.
+"""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt():
+    info = ray_tpu.init(num_cpus=4, num_tpus=0, ignore_reinit_error=True)
+    yield info
+    ray_tpu.shutdown()
+
+
+class TestDaskOnRay:
+    def test_raw_graph_executes(self, rt):
+        from ray_tpu.utils.dask import ray_dask_get
+
+        def add(a, b):
+            return a + b
+
+        def inc(a):
+            return a + 1
+
+        dsk = {
+            "x": 1,
+            "y": 2,
+            "a": (add, "x", "y"),        # 3
+            "b": (inc, "a"),             # 4
+            "c": (add, (inc, "b"), "a"),  # nested task: 5 + 3 = 8
+        }
+        assert ray_dask_get(dsk, "c") == 8
+        assert ray_dask_get(dsk, ["a", "b"]) == [3, 4]
+
+    def test_parallel_branches_are_cluster_tasks(self, rt):
+        from ray_tpu.utils.dask import ray_dask_get
+
+        def pid_of(_):
+            return os.getpid()
+
+        dsk = {f"p{i}": (pid_of, i) for i in range(4)}
+        pids = ray_dask_get(dsk, [f"p{i}" for i in range(4)])
+        # tasks ran in worker processes, not the driver
+        assert all(p != os.getpid() for p in pids)
+
+    def test_dask_collections_if_available(self, rt):
+        dask = pytest.importorskip("dask")
+        import dask.array  # noqa: F401  (requires dask[array])
+        from ray_tpu.utils.dask import (disable_dask_on_ray,
+                                        enable_dask_on_ray)
+
+        enable_dask_on_ray()
+        try:
+            import numpy as np
+
+            x = dask.array.ones((100, 100), chunks=(50, 50))
+            assert float((x + x).sum().compute()) == 20000.0
+            del np
+        finally:
+            disable_dask_on_ray()
+
+
+class TestSparkSeam:
+    def test_subprocess_launcher_cluster(self, rt):
+        """The injectable-launcher path: N worker 'executors' join the
+        head exactly as Spark tasks would (ref: setup_ray_cluster)."""
+        from ray_tpu.utils.spark import (setup_ray_cluster,
+                                         shutdown_ray_cluster,
+                                         subprocess_launcher)
+
+        try:
+            addr = setup_ray_cluster(num_worker_nodes=2,
+                                     num_cpus_per_node=1,
+                                     launcher=subprocess_launcher,
+                                     timeout_s=90)
+            assert addr.startswith("tcp:")
+            assert len(ray_tpu.nodes()) >= 3
+
+            @ray_tpu.remote(num_cpus=1)
+            def where():
+                return os.getpid()
+
+            pids = ray_tpu.get([where.remote() for _ in range(4)],
+                               timeout=120)
+            assert len(set(pids)) >= 1
+        finally:
+            shutdown_ray_cluster()
+
+    def test_double_setup_rejected(self, rt):
+        from ray_tpu.utils import spark as spark_mod
+
+        spark_mod._state["address"] = "tcp:x"
+        try:
+            with pytest.raises(RuntimeError, match="already up"):
+                spark_mod.setup_ray_cluster(
+                    num_worker_nodes=1,
+                    launcher=spark_mod.subprocess_launcher)
+        finally:
+            spark_mod._state["address"] = None
+
+
+class TestUsageStats:
+    def test_record_and_report(self, tmp_path, monkeypatch):
+        from ray_tpu import usage_stats as us
+
+        monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "1")
+        us.reset_for_testing()
+        us.record_library_usage("train")
+        us.record_library_usage("train")  # dedup
+        us.record_extra_usage_tag("backend", "tpu")
+        rep = us.generate_report()
+        assert rep["library_usages"] == ["train"]
+        assert rep["extra_usage_tags"] == {"backend": "tpu"}
+        assert "ray_tpu_version" in rep and "python_version" in rep
+        path = us.write_report(str(tmp_path))
+        assert path and json.load(open(path))["library_usages"] == \
+            ["train"]
+
+    def test_opt_out(self, tmp_path, monkeypatch):
+        from ray_tpu import usage_stats as us
+
+        monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+        us.reset_for_testing()
+        us.record_library_usage("serve")
+        assert us.generate_report()["library_usages"] == []
+        assert us.write_report(str(tmp_path)) is None
+        assert us.report_via(lambda r: None) is False
+
+    def test_library_imports_record(self, monkeypatch):
+        from ray_tpu import usage_stats as us
+
+        monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "1")
+        us.reset_for_testing()
+        import importlib
+
+        import ray_tpu.tune
+        importlib.reload(ray_tpu.tune)
+        assert "tune" in us.generate_report()["library_usages"]
+
+    def test_injectable_reporter(self, monkeypatch):
+        from ray_tpu import usage_stats as us
+
+        monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "1")
+        us.reset_for_testing()
+        us.record_library_usage("data")
+        got = []
+        assert us.report_via(got.append) is True
+        assert got[0]["library_usages"] == ["data"]
