@@ -10,9 +10,17 @@ py_modules / pip per task). Re-designed for a pre-baked TPU image:
   extract once per content digest and chdir into it for the task.
 - ``py_modules``: list of local package dirs shipped the same way and
   prepended to sys.path.
-- ``pip`` / ``conda``: rejected with a clear error — this environment is
-  a sealed image with no package index; dependencies must be pre-baked
-  (matching how TPU pod images are operated).
+- ``pip``: a venv-overlay (ref: runtime_env/pip.py). A virtualenv with
+  ``--system-site-packages`` is created per requirements digest; unmet
+  requirements are installed **offline** with ``pip install --no-index
+  --find-links <RAY_TPU_WHEEL_DIRS>`` (colon-separated local wheel
+  dirs). Requirements already satisfied by the baked image are
+  verified, not reinstalled. The venv's site-packages is prepended to
+  ``sys.path`` around task execution. No-network installs only: a
+  requirement that is neither baked in nor available as a local wheel
+  fails with a clear error (this is a sealed TPU image — there is no
+  package index at runtime).
+- ``conda``: rejected with a clear error — no conda on the image.
 
 Size cap: packed archives ride the control-plane KV, so each is capped
 (default 64 MiB) — big data belongs in the object store, not the env.
@@ -40,11 +48,19 @@ def validate(env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     unknown = set(env) - known
     if unknown:
         raise ValueError(f"unknown runtime_env keys: {sorted(unknown)}")
-    if env.get("pip") or env.get("conda"):
+    if env.get("conda"):
         raise ValueError(
-            "runtime_env pip/conda are not supported on this sealed image "
-            "(no package index at runtime); pre-bake dependencies into "
-            "the image instead")
+            "runtime_env conda is not supported on this sealed image; "
+            "use pip (offline venv overlay) or pre-bake dependencies")
+    pip = env.get("pip")
+    if pip is not None:
+        if isinstance(pip, dict):
+            pip = pip.get("packages", [])
+        if not (isinstance(pip, list) and
+                all(isinstance(r, str) for r in pip)):
+            raise ValueError(
+                "runtime_env pip must be a list of requirement strings "
+                "or {'packages': [...]}")
     ev = env.get("env_vars")
     if ev is not None and not (
             isinstance(ev, dict) and
@@ -121,6 +137,89 @@ def _materialize(ctx, uri: str) -> str:
     return dest
 
 
+def _pip_requirements(env: Dict[str, Any]) -> List[str]:
+    pip = env.get("pip")
+    if not pip:
+        return []
+    if isinstance(pip, dict):
+        pip = pip.get("packages", [])
+    return list(pip)
+
+
+def _satisfied(req: str) -> bool:
+    """True when the baked image already satisfies the requirement."""
+    from importlib import metadata
+
+    from packaging.requirements import InvalidRequirement, Requirement
+
+    try:
+        r = Requirement(req)
+        installed = metadata.version(r.name)
+    except (InvalidRequirement, metadata.PackageNotFoundError):
+        return False
+    return r.specifier.contains(installed, prereleases=True)
+
+
+def _ensure_venv(ctx, reqs: List[str]) -> str:
+    """Worker side: build (once per digest) the venv overlay for a pip
+    requirements list; returns its site-packages dir.
+
+    Offline by design: unmet requirements install from local wheel dirs
+    (``RAY_TPU_WHEEL_DIRS``, colon-separated) with ``--no-index``."""
+    import subprocess
+    import venv as venv_mod
+
+    digest = hashlib.sha256(
+        ("\n".join(sorted(reqs))).encode()).hexdigest()[:16]
+    dest = os.path.join(ctx.session_dir, "runtime_envs",
+                        f"venv-{digest}")
+    site = os.path.join(
+        dest, "lib",
+        f"python{sys.version_info.major}.{sys.version_info.minor}",
+        "site-packages")
+    if os.path.isdir(dest):
+        return site
+    unmet = [r for r in reqs if not _satisfied(r)]
+    tmp = f"{dest}.tmp.{os.getpid()}"
+    # with_pip (ensurepip) costs seconds; skip it when nothing installs
+    venv_mod.EnvBuilder(system_site_packages=True, with_pip=bool(unmet),
+                        symlinks=True).create(tmp)
+    if unmet:
+        wheel_dirs = [d for d in
+                      os.environ.get("RAY_TPU_WHEEL_DIRS", "").split(":")
+                      if d]
+        cmd = [os.path.join(tmp, "bin", "python"), "-m", "pip",
+               "install", "--quiet", "--no-index"]
+        for d in wheel_dirs:
+            cmd += ["--find-links", d]
+        cmd += unmet
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=300)
+            err, rc = proc.stderr, proc.returncode
+        except subprocess.TimeoutExpired as e:
+            err, rc = f"pip timed out after {e.timeout}s", -1
+        if rc != 0:
+            import shutil
+
+            # a half-built tmp venv must not survive: a same-pid retry
+            # would EnvBuilder.create() over it and cache the corruption
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"runtime_env pip: cannot satisfy {unmet} offline — not "
+                f"baked into the image and no matching wheel under "
+                f"RAY_TPU_WHEEL_DIRS={wheel_dirs or '(unset)'}; this is "
+                f"a sealed image with no package index.\n"
+                f"{(err or '').strip()[-2000:]}")
+    try:
+        os.rename(tmp, dest)
+    except OSError:  # raced with another worker — theirs is identical
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return site
+
+
 class applied:
     """Context manager applying a runtime_env around one task execution
     (the reference applies per worker-process; our workers are pooled
@@ -134,24 +233,58 @@ class applied:
         self._added_paths: List[str] = []
 
     def __enter__(self):
-        env = self._env
-        for k, v in (env.get("env_vars") or {}).items():
-            self._saved_environ[k] = os.environ.get(k)
-            os.environ[k] = v
-        wd = env.get("working_dir")
-        if wd:
-            path = _materialize(self._ctx, wd)
-            self._saved_cwd = os.getcwd()
-            os.chdir(path)
-            sys.path.insert(0, path)
-            self._added_paths.append(path)
-        for uri in env.get("py_modules") or []:
-            path = _materialize(self._ctx, uri)
-            sys.path.insert(0, path)
-            self._added_paths.append(path)
+        # a failure mid-application (e.g. an unsatisfiable pip env) must
+        # roll back what was already applied: the with-statement will not
+        # call __exit__ after a raising __enter__, and a pooled worker
+        # would otherwise keep the partial env forever
+        try:
+            env = self._env
+            for k, v in (env.get("env_vars") or {}).items():
+                self._saved_environ[k] = os.environ.get(k)
+                os.environ[k] = v
+            wd = env.get("working_dir")
+            if wd:
+                path = _materialize(self._ctx, wd)
+                self._saved_cwd = os.getcwd()
+                os.chdir(path)
+                sys.path.insert(0, path)
+                self._added_paths.append(path)
+            for uri in env.get("py_modules") or []:
+                path = _materialize(self._ctx, uri)
+                sys.path.insert(0, path)
+                self._added_paths.append(path)
+            reqs = _pip_requirements(env)
+            if reqs:
+                site = _ensure_venv(self._ctx, reqs)
+                sys.path.insert(0, site)
+                self._added_paths.append(site)
+        except BaseException:
+            self.__exit__(*sys.exc_info())
+            raise
         return self
 
     def __exit__(self, *exc):
+        # purge modules imported from overlay paths: workers are pooled,
+        # so a cached import would leak this env's packages into later
+        # tasks that did not request them
+        if self._added_paths:
+            roots = tuple(os.path.abspath(p) + os.sep
+                          for p in self._added_paths)
+
+            def _under(mod) -> bool:
+                f = getattr(mod, "__file__", None)
+                if f and os.path.abspath(f).startswith(roots):
+                    return True
+                # namespace packages have __file__=None but carry the
+                # overlay in __path__
+                for p in list(getattr(mod, "__path__", None) or []):
+                    if os.path.abspath(p).startswith(roots):
+                        return True
+                return False
+
+            for name, mod in list(sys.modules.items()):
+                if _under(mod):
+                    del sys.modules[name]
         for p in self._added_paths:
             try:
                 sys.path.remove(p)

@@ -31,6 +31,7 @@ from .api import (
 from .batching import batch
 from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
 from .deployment import Application, Deployment, deployment
+from .grpc_proxy import start_grpc, stop_grpc
 from .handle import (DeploymentHandle, DeploymentResponse,
                      DeploymentResponseGenerator)
 from .multiplex import get_multiplexed_model_id, multiplexed
@@ -38,7 +39,8 @@ from .schema import deploy_config
 
 __all__ = [
     "deployment", "Deployment", "Application", "run", "delete", "status",
-    "shutdown", "start", "proxy_ports", "batch", "get_app_handle", "get_deployment_handle",
+    "shutdown", "start", "start_grpc", "stop_grpc",
+    "proxy_ports", "batch", "get_app_handle", "get_deployment_handle",
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
     "multiplexed", "get_multiplexed_model_id", "deploy_config",
     "AutoscalingConfig",

@@ -229,8 +229,14 @@ def proxy_ports() -> dict:
 
 
 def shutdown():
-    """Tear down all applications, the proxy, and the controller."""
+    """Tear down all applications, the proxies, and the controller."""
+    from .grpc_proxy import stop_grpc
     from .router import reset_routers
+
+    try:
+        stop_grpc()
+    except Exception:
+        pass
 
     proxy_names = [_proxy_name(n["node_idx"]) for n in ray_tpu.nodes()]
     if PROXY_NAME not in proxy_names:

@@ -1,0 +1,191 @@
+"""gRPC ingress proxy for Serve applications.
+
+Ref analog: the reference's experimental gRPC ingress —
+python/ray/serve/drivers.py (gRPCIngress) and
+python/ray/serve/_private/grpc_util.py (RayServeAPIService wiring) —
+re-designed without protoc codegen: the service is registered with
+``grpc.method_handlers_generic_handler`` using identity (bytes)
+serializers, so any gRPC client can call it by full method name with
+JSON payloads.  Service surface:
+
+  /ray.serve.ServeAPIService/Healthz           unary-unary
+  /ray.serve.ServeAPIService/ListApplications  unary-unary
+  /ray.serve.ServeAPIService/Predict           unary-unary
+  /ray.serve.ServeAPIService/Streaming         unary-stream
+
+Routing follows the reference's metadata convention: the target app is
+the ``application`` entry in the call's invocation metadata, falling
+back to the single deployed app when only one exists.  Request bytes
+are JSON-decoded into the handle argument; responses are JSON bytes
+(or raw bytes passthrough when the deployment returns ``bytes``).
+
+Backpressure: ``maximum_concurrent_rpcs`` on the grpc server rejects
+excess calls with RESOURCE_EXHAUSTED — the proxy-level saturation
+semantics the HTTP proxy expresses with 503 + Retry-After.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import ray_tpu
+
+GRPC_PROXY_NAME = "SERVE_GRPC_PROXY"
+SERVICE_NAME = "ray.serve.ServeAPIService"
+_ROUTES_TTL_S = 1.0
+_REQUEST_TIMEOUT_S = 60.0
+
+
+def _ident(b: bytes) -> bytes:
+    return b
+
+
+class GrpcProxy:
+    """Actor hosting the gRPC server (one per cluster by default)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_concurrent_rpcs: int = 256, workers: int = 16):
+        import grpc
+
+        self._controller = None
+        self._apps: dict = {}
+        self._apps_at = 0.0
+        self._handles: dict = {}
+        self._server = grpc.server(
+            ThreadPoolExecutor(max_workers=workers,
+                               thread_name_prefix="serve-grpc"),
+            maximum_concurrent_rpcs=max_concurrent_rpcs)
+        handlers = {
+            "Healthz": grpc.unary_unary_rpc_method_handler(
+                self._healthz, _ident, _ident),
+            "ListApplications": grpc.unary_unary_rpc_method_handler(
+                self._list_apps, _ident, _ident),
+            "Predict": grpc.unary_unary_rpc_method_handler(
+                self._predict, _ident, _ident),
+            "Streaming": grpc.unary_stream_rpc_method_handler(
+                self._streaming, _ident, _ident),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),))
+        self._port = self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+
+    # ------------------------------------------------------------- handlers
+
+    def _healthz(self, request: bytes, context) -> bytes:
+        return b'{"status": "ok"}'
+
+    def _list_apps(self, request: bytes, context) -> bytes:
+        return json.dumps(sorted(self._app_table())).encode()
+
+    def _predict(self, request: bytes, context) -> bytes:
+        handle, arg = self._resolve(request, context)
+        resp = handle.remote(arg)
+        result = resp.result(timeout_s=_REQUEST_TIMEOUT_S)
+        if isinstance(result, bytes):
+            return result
+        return json.dumps(result).encode()
+
+    def _streaming(self, request: bytes, context):
+        handle, arg = self._resolve(request, context)
+        for item in handle.options(stream=True).remote(arg):
+            yield (item if isinstance(item, bytes)
+                   else json.dumps(item).encode())
+
+    # -------------------------------------------------------------- routing
+
+    def _resolve(self, request: bytes, context):
+        import grpc
+
+        md = dict(context.invocation_metadata() or ())
+        apps = self._app_table()
+        app = md.get("application")
+        if app is None and len(apps) == 1:
+            app = next(iter(apps))
+        if app is None or app not in apps:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"application {app!r} not found; deployed: {sorted(apps)}")
+        arg = None
+        if request:
+            try:
+                arg = json.loads(request)
+            except json.JSONDecodeError:
+                arg = request  # raw-bytes passthrough
+        return self._app_handle(app), arg
+
+    def _controller_handle(self):
+        if self._controller is None:
+            from .controller import CONTROLLER_NAME
+
+            self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        return self._controller
+
+    def _app_table(self) -> dict:
+        """app name -> route prefix, with the same TTL/staleness policy
+        as the HTTP proxy's route table."""
+        if time.monotonic() - self._apps_at > _ROUTES_TTL_S:
+            try:
+                routes = ray_tpu.get(
+                    self._controller_handle().get_routes.remote(),
+                    timeout=10)
+                self._apps = {app: prefix
+                              for prefix, app in routes.items()}
+                self._apps_at = time.monotonic()
+                self._handles = {}
+            except Exception:  # noqa: BLE001 — keep serving stale table
+                pass
+        return self._apps
+
+    def _app_handle(self, app: str):
+        from .handle import DeploymentHandle
+
+        handle = self._handles.get(app)
+        if handle is None:
+            ingress = ray_tpu.get(
+                self._controller_handle().get_ingress.remote(app),
+                timeout=10)
+            handle = DeploymentHandle(ingress, app)
+            self._handles[app] = handle
+        return handle
+
+    # -------------------------------------------------------------- public
+
+    def port(self) -> int:
+        return self._port
+
+    def ready(self) -> bool:
+        return True
+
+    def stop(self):
+        self._server.stop(grace=1.0)
+        return True
+
+
+def start_grpc(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Start the gRPC ingress (idempotent); returns the bound port."""
+    from .api import get_or_create_controller
+
+    get_or_create_controller()
+    try:
+        proxy = ray_tpu.get_actor(GRPC_PROXY_NAME)
+    except ValueError:
+        proxy = ray_tpu.remote(GrpcProxy).options(
+            name=GRPC_PROXY_NAME, num_cpus=0, max_concurrency=32).remote(
+                host, port)
+    return ray_tpu.get(proxy.port.remote(), timeout=30)
+
+
+def stop_grpc():
+    try:
+        proxy = ray_tpu.get_actor(GRPC_PROXY_NAME)
+    except ValueError:
+        return
+    try:
+        ray_tpu.get(proxy.stop.remote(), timeout=10)
+    except Exception:  # noqa: BLE001
+        pass
+    ray_tpu.kill(proxy)

@@ -16,9 +16,12 @@ import ray_tpu
 from ray_tpu.runtime_env import validate
 
 
-def test_validate_rejects_pip_and_unknown():
-    with pytest.raises(ValueError, match="pre-bake"):
-        validate({"pip": ["requests"]})
+def test_validate_rejects_conda_and_unknown():
+    with pytest.raises(ValueError, match="conda"):
+        validate({"conda": {"dependencies": ["x"]}})
+    with pytest.raises(ValueError, match="requirement strings"):
+        validate({"pip": "requests"})
+    assert validate({"pip": ["requests"]}) == {"pip": ["requests"]}
     with pytest.raises(ValueError, match="unknown"):
         validate({"bogus_key": 1})
     with pytest.raises(ValueError, match="env_vars"):

@@ -1,0 +1,96 @@
+"""Runtime-env pip venv-overlay tests.
+
+Ref analog: python/ray/tests/test_runtime_env_conda_and_pip.py — pip
+requirements materialized per env and applied to tasks. Here the venv
+is an offline overlay: satisfied requirements verify against the baked
+image; unmet ones install from local wheel dirs with --no-index.
+"""
+
+import json
+import os
+import sys
+import zipfile
+
+import pytest
+
+import ray_tpu
+
+
+def _make_wheel(dirpath: str, name: str = "tinypkg_xyz",
+                version: str = "1.0") -> str:
+    """Handcraft a minimal PEP-427 wheel (no build tooling needed)."""
+    whl = os.path.join(dirpath, f"{name}-{version}-py3-none-any.whl")
+    dist = f"{name}-{version}.dist-info"
+    files = {
+        f"{name}/__init__.py": "VALUE = 42\n",
+        f"{dist}/METADATA": (
+            f"Metadata-Version: 2.1\nName: {name}\n"
+            f"Version: {version}\n"),
+        f"{dist}/WHEEL": ("Wheel-Version: 1.0\nGenerator: test\n"
+                          "Root-Is-Purelib: true\nTag: py3-none-any\n"),
+    }
+    record = "".join(f"{p},,\n" for p in files) + f"{dist}/RECORD,,\n"
+    files[f"{dist}/RECORD"] = record
+    with zipfile.ZipFile(whl, "w") as zf:
+        for path, content in files.items():
+            zf.writestr(path, content)
+    return whl
+
+
+def test_pip_satisfied_by_image(ray_start):
+    """Requirements the baked image already meets verify without any
+    install; the overlay site-packages is on sys.path during the task
+    and removed after."""
+
+    @ray_tpu.remote
+    def probe():
+        overlays = [p for p in sys.path if "venv-" in p]
+        return json.dumps(overlays)
+
+    task = probe.options(runtime_env={"pip": ["pytest", "numpy"]})
+    overlays = json.loads(ray_tpu.get(task.remote(), timeout=120))
+    assert len(overlays) == 1 and "site-packages" in overlays[0]
+    # overlay must not leak into plain tasks on the pooled worker
+    assert json.loads(ray_tpu.get(probe.remote(), timeout=60)) == []
+
+
+@pytest.mark.slow
+def test_pip_installs_local_wheel(ray_start, tmp_path):
+    _make_wheel(str(tmp_path))
+
+    @ray_tpu.remote
+    def use_pkg():
+        import tinypkg_xyz
+
+        return tinypkg_xyz.VALUE
+
+    # env_vars ride the runtime_env so the wheel dir reaches the pooled
+    # worker process (applied before the venv build)
+    task = use_pkg.options(
+        runtime_env={"pip": ["tinypkg_xyz==1.0", "pytest"],
+                     "env_vars": {"RAY_TPU_WHEEL_DIRS": str(tmp_path)}})
+    assert ray_tpu.get(task.remote(), timeout=300) == 42
+    # the sealed image does NOT have the package outside the overlay
+    with pytest.raises(Exception):
+        ray_tpu.get(use_pkg.remote(), timeout=60)
+
+
+def test_pip_unsatisfiable_fails_clearly(ray_start):
+    @ray_tpu.remote
+    def nop():
+        return 1
+
+    task = nop.options(
+        runtime_env={"pip": ["definitely-not-a-real-pkg-xyz==9.9"],
+                     "env_vars": {"PIP_FAIL_PROBE": "set"}})
+    with pytest.raises(Exception, match="sealed image|cannot satisfy"):
+        ray_tpu.get(task.remote(), timeout=300)
+
+    # the failed env application must roll back: the pooled worker that
+    # hit the pip failure already had env_vars applied, and a raising
+    # __enter__ gets no __exit__ from the with-statement
+    @ray_tpu.remote
+    def probe_env():
+        return os.environ.get("PIP_FAIL_PROBE")
+
+    assert ray_tpu.get(probe_env.remote(), timeout=60) is None

@@ -1,0 +1,103 @@
+"""gRPC ingress tests.
+
+Ref analog: the reference's gRPC ingress tests
+(python/ray/serve/tests/test_grpc.py shape) — unary call, streaming
+call, app routing via metadata, NOT_FOUND for unknown apps, health.
+"""
+
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+grpc = pytest.importorskip("grpc")
+
+
+@pytest.fixture(scope="module")
+def rt():
+    info = ray_tpu.init(num_cpus=4, num_tpus=0, ignore_reinit_error=True)
+    yield info
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def grpc_session(rt):
+    yield
+    serve.shutdown()
+
+
+@serve.deployment
+def echo(x):
+    return {"echo": x}
+
+
+@serve.deployment
+class Streamer:
+    def __call__(self, n):
+        for i in range(int(n)):
+            yield {"i": i}
+
+
+def _channel(port):
+    return grpc.insecure_channel(f"127.0.0.1:{port}")
+
+
+def _unary(channel, method, payload=b"", metadata=None):
+    fn = channel.unary_unary(
+        f"/ray.serve.ServeAPIService/{method}",
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b)
+    return fn(payload, metadata=metadata, timeout=30)
+
+
+class TestGrpcIngress:
+    def test_healthz_and_predict(self, grpc_session):
+        serve.run(echo.bind(), name="echoapp", route_prefix="/echo")
+        port = serve.start_grpc()
+        with _channel(port) as ch:
+            assert json.loads(_unary(ch, "Healthz"))["status"] == "ok"
+            apps = json.loads(_unary(ch, "ListApplications"))
+            assert "echoapp" in apps
+            out = _unary(ch, "Predict", json.dumps(7).encode(),
+                         metadata=(("application", "echoapp"),))
+            assert json.loads(out) == {"echo": 7}
+
+    def test_single_app_default_routing(self, grpc_session):
+        serve.run(echo.bind(), name="only", route_prefix="/only")
+        port = serve.start_grpc()
+        with _channel(port) as ch:
+            out = _unary(ch, "Predict", json.dumps("hi").encode())
+            assert json.loads(out) == {"echo": "hi"}
+
+    def test_unknown_app_not_found(self, grpc_session):
+        serve.run(echo.bind(), name="a1", route_prefix="/a1")
+        serve.run(echo.bind(), name="a2", route_prefix="/a2")
+        port = serve.start_grpc()
+        with _channel(port) as ch:
+            with pytest.raises(grpc.RpcError) as e:
+                _unary(ch, "Predict", b"1",
+                       metadata=(("application", "nope"),))
+            assert e.value.code() == grpc.StatusCode.NOT_FOUND
+
+    def test_streaming(self, grpc_session):
+        serve.run(Streamer.bind(), name="stream", route_prefix="/stream")
+        port = serve.start_grpc()
+        with _channel(port) as ch:
+            fn = ch.unary_stream(
+                "/ray.serve.ServeAPIService/Streaming",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b)
+            items = [json.loads(b) for b in
+                     fn(json.dumps(4).encode(),
+                        metadata=(("application", "stream"),),
+                        timeout=60)]
+        assert items == [{"i": 0}, {"i": 1}, {"i": 2}, {"i": 3}]
+
+    def test_idempotent_start_same_port(self, grpc_session):
+        serve.run(echo.bind(), name="idem", route_prefix="/idem")
+        p1 = serve.start_grpc()
+        p2 = serve.start_grpc()
+        assert p1 == p2
