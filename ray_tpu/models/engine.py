@@ -39,6 +39,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Dict, List, Optional, Sequence
@@ -230,6 +231,15 @@ _FINISH_EOS = "eos"
 _FINISH_LENGTH = "length"
 
 
+def _chunk_ready(x) -> bool:
+    """True when the device has finished computing ``x`` (non-blocking);
+    conservatively False on backends without is_ready."""
+    try:
+        return bool(x.is_ready())
+    except AttributeError:
+        return False
+
+
 @dataclass
 class _Request:
     rid: int
@@ -357,7 +367,9 @@ class InferenceEngine:
         # running counters for benchmarking / observability
         self.stats = {"prefills": 0, "prefill_dispatches": 0,
                       "decode_steps": 0, "fetches": 0, "tokens_out": 0,
-                      "requests_done": 0}
+                      "requests_done": 0, "fetch_wall_s": 0.0,
+                      "cap_stalls": 0, "dispatch_wall_s": 0.0}
+        self._at_cap = False
 
     # -------------------------------------------------------- submission
 
@@ -455,18 +467,23 @@ class InferenceEngine:
             for K in sizes:
                 toks = np.full((K, bucket), self.pad_id, np.int32)
                 toks[:, -1] = 1
-                self.cache, _ = prefill_slots(
+                self.cache, first = prefill_slots(
                     self.params, self.cache, jnp.asarray(toks),
                     jnp.arange(K, dtype=jnp.int32),
                     jnp.full((K,), bucket - 1, jnp.int32),
                     self._next_rng(), self.cfg, self.greedy,
                     self.temperature)
+                # warm the chain-merge too (_admit_group runs it per
+                # group size; a mid-traffic compile stalls the loop)
+                self._next_tok_dev = self._next_tok_dev.at[
+                    jnp.arange(K, dtype=jnp.int32)].set(first)
         cache, toks = decode_slots(
             self.params, self.cache, self._next_tok_dev,
             jnp.ones(self.slots, bool), self._next_rng(), self.cfg,
             self.greedy, self.temperature, self.eos_id,
             steps=self.decode_chunk)
-        jax.block_until_ready(toks)
+        self._next_tok_dev = toks[:, -1]  # warm the last-column slice
+        jax.block_until_ready(self._next_tok_dev)
         # reset bookkeeping: positions to zero, junk K/V is unreachable
         self.cache = {"k": cache["k"], "v": cache["v"],
                       "pos": jnp.zeros_like(cache["pos"]),
@@ -567,7 +584,15 @@ class InferenceEngine:
             return False
         if self._fetcher is not None and \
                 len(self._inflight) >= self.max_inflight:
+            # count stall EPISODES, not the parked loop's 50ms wakeups —
+            # one fetch-bound stall would otherwise inflate the counter
+            # by however many times the loop re-polled it
+            if not self._at_cap:
+                self.stats["cap_stalls"] += 1
+                self._at_cap = True
             return False  # dispatch-ahead cap: wait for the fetcher
+        self._at_cap = False
+        t0 = time.perf_counter()
         width = self.decode_chunk
         snapshot = []
         for slot in active_slots:
@@ -586,17 +611,23 @@ class InferenceEngine:
             self.greedy, self.temperature, self.eos_id, steps=width)
         self._next_tok_dev = toks[:, -1]
         self.stats["decode_steps"] += width
+        self.stats["dispatch_wall_s"] += time.perf_counter() - t0
         self._inflight.append((toks, snapshot))
         return True
 
     def _fetch_chunks(self, pending) -> np.ndarray:
-        """One device-side concat + ONE host transfer for ``pending``
-        chunks (each [B, decode_chunk+1]). Called outside the lock by
-        the fetcher; inline mode calls it under the lock."""
-        parts = [t for t, _ in pending]
-        big = np.asarray(parts[0] if len(parts) == 1
-                         else jnp.concatenate(parts, axis=1))
+        """ONE batched host transfer for ``pending`` chunks (each
+        [B, decode_chunk+1]), concatenated on the host. Device-side
+        concat would compile a fresh program per distinct chunk count —
+        mid-traffic compiles measured as multi-second stalls through the
+        tunneled backend. Called outside the lock by the fetcher; inline
+        mode calls it under the lock."""
+        t0 = time.perf_counter()
+        parts = jax.device_get([t for t, _ in pending])
+        big = parts[0] if len(parts) == 1 else np.concatenate(
+            parts, axis=1)
         self.stats["fetches"] += 1
+        self.stats["fetch_wall_s"] += time.perf_counter() - t0
         return big
 
     def _deliver_locked(self, big: np.ndarray, pending) -> None:
@@ -653,9 +684,21 @@ class InferenceEngine:
                     return
                 self._fetch_evt.wait(timeout=0.05)
                 with self._lock:
-                    pending, self._inflight = self._inflight, []
-                    if not pending:
+                    if not self._inflight:
                         self._fetch_evt.clear()
+                        pending = []
+                    else:
+                        # take the OLDEST chunk (delivery must advance)
+                        # plus any younger chunks the device has already
+                        # finished — their transfer piggybacks for free.
+                        # Taking the whole backlog instead would block
+                        # this cycle on the newest, just-dispatched chunk
+                        # and stretch delivery latency to the backlog
+                        # depth.
+                        pending = [self._inflight.pop(0)]
+                        while self._inflight and \
+                                _chunk_ready(self._inflight[0][0]):
+                            pending.append(self._inflight.pop(0))
                 if not pending:
                     continue
                 # taking the chunks made room under the dispatch cap —

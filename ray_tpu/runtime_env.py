@@ -146,18 +146,41 @@ def _pip_requirements(env: Dict[str, Any]) -> List[str]:
     return list(pip)
 
 
-def _satisfied(req: str) -> bool:
-    """True when the baked image already satisfies the requirement."""
+def _satisfied(req: str, _depth: int = 0) -> bool:
+    """True when the baked image already satisfies the requirement —
+    including environment markers (a marker-excluded requirement is
+    vacuously satisfied, not an install failure) and extras (each
+    extra's own dependency set must be present too)."""
     from importlib import metadata
 
     from packaging.requirements import InvalidRequirement, Requirement
 
     try:
         r = Requirement(req)
-        installed = metadata.version(r.name)
-    except (InvalidRequirement, metadata.PackageNotFoundError):
+    except InvalidRequirement:
         return False
-    return r.specifier.contains(installed, prereleases=True)
+    if r.marker is not None and not r.marker.evaluate():
+        return True  # requirement does not apply on this platform
+    try:
+        installed = metadata.version(r.name)
+    except metadata.PackageNotFoundError:
+        return False
+    if not r.specifier.contains(installed, prereleases=True):
+        return False
+    if r.extras and _depth < 4:
+        for dep in metadata.requires(r.name) or []:
+            try:
+                d = Requirement(dep)
+            except InvalidRequirement:
+                continue
+            if d.marker is None:
+                continue  # base dep, already present with the package
+            for extra in r.extras:
+                if d.marker.evaluate({"extra": extra}):
+                    base = str(d).split(";", 1)[0].strip()
+                    if not _satisfied(base, _depth + 1):
+                        return False
+    return True
 
 
 def _ensure_venv(ctx, reqs: List[str]) -> str:

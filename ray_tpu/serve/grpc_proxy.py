@@ -27,6 +27,7 @@ semantics the HTTP proxy expresses with 503 + Retry-After.
 from __future__ import annotations
 
 import json
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
@@ -47,13 +48,23 @@ class GrpcProxy:
     """Actor hosting the gRPC server (one per cluster by default)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 max_concurrent_rpcs: int = 256, workers: int = 16):
+                 max_concurrent_rpcs: Optional[int] = None,
+                 workers: int = 16):
         import grpc
 
         self._controller = None
         self._apps: dict = {}
         self._apps_at = 0.0
         self._handles: dict = {}
+        self._refresh_lock = threading.Lock()
+        # rejection must be prompt: each handler can block its executor
+        # thread up to the request timeout, so the RPC cap is tied to
+        # the thread count (workers running + workers queued) — not an
+        # arbitrary large constant that would let calls 17..N sit in the
+        # executor queue until DEADLINE_EXCEEDED instead of failing fast
+        # with RESOURCE_EXHAUSTED
+        if max_concurrent_rpcs is None:
+            max_concurrent_rpcs = workers * 2
         self._server = grpc.server(
             ThreadPoolExecutor(max_workers=workers,
                                thread_name_prefix="serve-grpc"),
@@ -126,18 +137,27 @@ class GrpcProxy:
 
     def _app_table(self) -> dict:
         """app name -> route prefix, with the same TTL/staleness policy
-        as the HTTP proxy's route table."""
+        as the HTTP proxy's route table. Refreshes are coalesced: one
+        controller RPC per expiry no matter how many handler threads
+        cross the TTL together (the HTTP proxy learned this the hard
+        way — the per-request controller RPC dominated proxy latency)."""
         if time.monotonic() - self._apps_at > _ROUTES_TTL_S:
-            try:
-                routes = ray_tpu.get(
-                    self._controller_handle().get_routes.remote(),
-                    timeout=10)
-                self._apps = {app: prefix
-                              for prefix, app in routes.items()}
-                self._apps_at = time.monotonic()
-                self._handles = {}
-            except Exception:  # noqa: BLE001 — keep serving stale table
-                pass
+            if self._refresh_lock.acquire(blocking=False):
+                try:
+                    if time.monotonic() - self._apps_at > _ROUTES_TTL_S:
+                        routes = ray_tpu.get(
+                            self._controller_handle().get_routes.remote(),
+                            timeout=10)
+                        self._apps = {app: prefix
+                                      for prefix, app in routes.items()}
+                        self._apps_at = time.monotonic()
+                        self._handles = {}
+                except Exception:  # noqa: BLE001 — keep serving stale
+                    pass
+                finally:
+                    self._refresh_lock.release()
+            # losers of the acquire race serve the (possibly stale)
+            # table immediately rather than stacking up behind the RPC
         return self._apps
 
     def _app_handle(self, app: str):
@@ -166,7 +186,11 @@ class GrpcProxy:
 
 
 def start_grpc(host: str = "127.0.0.1", port: int = 0) -> int:
-    """Start the gRPC ingress (idempotent); returns the bound port."""
+    """Start the gRPC ingress (idempotent); returns the bound port.
+
+    Like ``serve.start()``, host/port apply only on first start: if the
+    proxy actor already exists its existing binding is returned (call
+    ``stop_grpc()`` first to rebind)."""
     from .api import get_or_create_controller
 
     get_or_create_controller()

@@ -1,0 +1,40 @@
+"""In-process engine-knob sweep: build + compile once, test many
+(decode_chunk, max_inflight) configs against the bench_serve workload.
+Tuning tool only — the checked-in artifact comes from bench_serve.py."""
+
+import json
+import os
+import sys
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import bench_serve as bs
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama3-1b")
+    ap.add_argument("--duration", type=float, default=12.0)
+    ap.add_argument("--clients", type=int, default=24)
+    ap.add_argument("--configs", default="16:4,16:3,12:4,24:3")
+    args = ap.parse_args()
+
+    model_name, cfg, params = bs._build(args.model)
+    dur = min(args.duration, 6.0) if model_name == "tiny" else args.duration
+    for spec in args.configs.split(","):
+        chunk, inflight = (int(x) for x in spec.split(":"))
+        r = bs.bench_continuous(
+            cfg, params, slots=8, max_prompt=64, max_new=64,
+            clients=args.clients, duration_s=dur,
+            decode_chunk=chunk, fetch_every=4, max_inflight=inflight)
+        r["decode_chunk"], r["max_inflight"] = chunk, inflight
+        print(json.dumps(r), flush=True)
+        print(json.dumps(r), file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()
