@@ -27,13 +27,19 @@ import time
 os.environ.setdefault("RAY_TPU_PRESTART_WORKERS", "0")
 os.environ.setdefault("TPU_CHIPS", "0")
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the actor wave spawns ~1k real interpreter processes; on a small host
+# that is spawn-bound at a few per second, so the default 60s creation
+# deadline would mass-kill the tail of the wave mid-benchmark
+os.environ.setdefault("RAY_TPU_ACTOR_CREATION_TIMEOUT_S", "1800")
 
 
 def bench_many_nodes(cluster, n: int) -> dict:
     """Node registration + scheduler-table update rate."""
     t0 = time.perf_counter()
     for _ in range(n):
-        cluster.add_node(num_cpus=1)
+        # small stores: hundreds of virtual nodes x the 512 MiB default
+        # would pin tens of GiB of tmpfs for data this phase never moves
+        cluster.add_node(num_cpus=1, object_store_memory=64 << 20)
     dt = time.perf_counter() - t0
     import ray_tpu
 
@@ -136,20 +142,29 @@ def main():
                      "(64 nodes x 64 cores)",
     }
 
+    def flush():
+        # partial results survive a later phase dying (e.g. the actor
+        # wave timing out): the artifact is written after EVERY phase
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+
     cluster = Cluster(initialize_head=True,
                       head_node_args={"num_cpus": 4, "num_tpus": 0})
     try:
         print(f"# many_nodes({args.nodes})", file=sys.stderr, flush=True)
         result["many_nodes"] = bench_many_nodes(cluster, args.nodes)
         print(json.dumps(result["many_nodes"]), file=sys.stderr)
+        flush()
 
         print(f"# many_tasks({args.tasks})", file=sys.stderr, flush=True)
         result["many_tasks"] = bench_many_tasks(args.tasks, args.nodes)
         print(json.dumps(result["many_tasks"]), file=sys.stderr)
+        flush()
 
         print(f"# many_pgs({args.pgs})", file=sys.stderr, flush=True)
         result["many_pgs"] = bench_many_pgs(args.pgs)
         print(json.dumps(result["many_pgs"]), file=sys.stderr)
+        flush()
     finally:
         cluster.shutdown()
 
@@ -160,11 +175,12 @@ def main():
                       head_node_args={"num_cpus": 4, "num_tpus": 0})
     try:
         for _ in range(n_nodes):
-            cluster.add_node(num_cpus=12)
+            cluster.add_node(num_cpus=12, object_store_memory=64 << 20)
         print(f"# many_actors({args.actors}) over {n_nodes} nodes",
               file=sys.stderr, flush=True)
         result["many_actors"] = bench_many_actors(args.actors)
         print(json.dumps(result["many_actors"]), file=sys.stderr)
+        flush()
     finally:
         cluster.shutdown()
 

@@ -100,16 +100,41 @@ class ShmObjectStore:
             self._mmap = mmap.mmap(fd, 0)
         finally:
             os.close(fd)
-        try:
-            # Pre-wire this process's PTEs for the whole arena (the C side
-            # already zero-filled the tmpfs pages at creation): without it
-            # the first write pass over the arena eats ~25k minor faults
-            # per 100 MiB, visibly denting put bandwidth.
-            self._mmap.madvise(getattr(mmap, "MADV_POPULATE_WRITE", 23))
-        except (OSError, ValueError):
-            pass  # pre-5.14 kernel: keep lazy faulting
         self._closed = False
         self._lock = threading.Lock()
+        # Populate the arena's tmpfs pages + this process's PTEs in the
+        # BACKGROUND, in bounded chunks, for creators AND attachers:
+        # lazy faulting costs ~25k minor faults (+ kernel zeroing, for
+        # the first toucher) per 100 MiB on first writes — halves
+        # measured put bandwidth in whichever process does the writing,
+        # usually an attacher. A synchronous whole-arena
+        # MADV_POPULATE_WRITE was measured to degrade from 0.2s to ~10s
+        # per 512 MiB as populated segments accumulate on the deployment
+        # kernel, serializing node registration (many_nodes fell to 0.2
+        # nodes/s); chunked + off-thread keeps create/attach O(1).
+        threading.Thread(target=self._populate_bg,
+                         name=f"shm-populate-{name}",
+                         daemon=True).start()
+
+    _POPULATE_CHUNK = 64 << 20
+
+    def _populate_bg(self):
+        advice = getattr(mmap, "MADV_POPULATE_WRITE", 23)
+        off, total = 0, None
+        while True:
+            with self._lock:
+                if self._closed:
+                    return
+                try:
+                    if total is None:
+                        total = len(self._mmap)
+                    if off >= total:
+                        return
+                    n = min(self._POPULATE_CHUNK, total - off)
+                    self._mmap.madvise(advice, off, n)
+                except (OSError, ValueError):
+                    return  # pre-5.14 kernel or racing close: lazy-fault
+            off += n
 
     # -- raw object interface -------------------------------------------------
 
@@ -254,11 +279,17 @@ class ShmObjectStore:
     def close(self):
         if self._closed:
             return
-        self._closed = True
-        try:
-            self._mmap.close()
-        except BufferError:
-            pass  # zero-copy views still alive; leave the map
+        # _lock serializes against an in-flight background populate
+        # chunk: munmap under a concurrent madvise would be a
+        # use-after-free of the mapping
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                self._mmap.close()
+            except BufferError:
+                pass  # zero-copy views still alive; leave the map
         lib = get_lib()
         if self._creator:
             lib.shm_store_destroy(self._h, self._cname)

@@ -243,15 +243,13 @@ void* shm_store_create(const char* name, uint64_t segment_size) {
     shm_unlink(name);
     return nullptr;
   }
-  // Pre-populate the arena's pages at creation: tmpfs allocates a page on
-  // its first WRITE fault, so an un-touched arena costs ~25k faults + kernel
-  // zeroing per 100 MiB on the very first object writes — halving measured
-  // put bandwidth until the arena has been written once. POPULATE_WRITE
-  // (Linux 5.14+) zero-fills everything up front; older kernels just keep
-  // the lazy behavior.
-#ifdef MADV_POPULATE_WRITE
-  madvise(base, segment_size, MADV_POPULATE_WRITE);
-#endif
+  // NOTE on pre-population: tmpfs allocates a page on its first WRITE
+  // fault, so an un-touched arena costs ~25k faults + kernel zeroing per
+  // 100 MiB on the very first object writes. An eager synchronous
+  // MADV_POPULATE_WRITE here was measured to degrade pathologically as
+  // populated segments accumulate (0.2s -> 10s per 512 MiB arena on the
+  // deployment kernel), serializing node registration; the Python side
+  // now populates in bounded chunks from a background thread instead.
   Store* s = new Store{fd, static_cast<uint8_t*>(base), static_cast<Header*>(base)};
   Header* h = s->hdr;
   memset(h, 0, sizeof(Header));

@@ -142,7 +142,14 @@ class GrpcProxy:
         cross the TTL together (the HTTP proxy learned this the hard
         way — the per-request controller RPC dominated proxy latency)."""
         if time.monotonic() - self._apps_at > _ROUTES_TTL_S:
-            if self._refresh_lock.acquire(blocking=False):
+            # cold start (never loaded) must BLOCK on the lock: serving
+            # the initial empty table would turn a racing first request
+            # into a spurious NOT_FOUND, which gRPC clients don't retry.
+            # After first load, losers of the acquire race serve the
+            # (possibly stale) table instead of stacking up behind the
+            # RPC.
+            never_loaded = self._apps_at == 0.0
+            if self._refresh_lock.acquire(blocking=never_loaded):
                 try:
                     if time.monotonic() - self._apps_at > _ROUTES_TTL_S:
                         routes = ray_tpu.get(
@@ -156,8 +163,6 @@ class GrpcProxy:
                     pass
                 finally:
                     self._refresh_lock.release()
-            # losers of the acquire race serve the (possibly stale)
-            # table immediately rather than stacking up behind the RPC
         return self._apps
 
     def _app_handle(self, app: str):
