@@ -16,10 +16,19 @@ Run: python bench_rllib.py [--duration 20]
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+# the host sitecustomize force-registers the axon TPU backend at
+# interpreter start, overriding the standard JAX_PLATFORMS env var (and
+# wedging forever if the tunnel is sick); restore the expected semantics
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
 def _fake_ppo_batch(obs_dim, num_actions, n, seed=0):
@@ -109,6 +118,11 @@ def main():
               "env": "Breakout-Mini-v0 (MinAtar-class, obs 400)",
               "model_hiddens": [256, 256]}
 
+    def flush():
+        # partial artifact survives a later phase dying / timing out
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+
     # ---- learner-only throughput (row-3 metric) ----
     ppo = PPOLearner(obs_dim, num_actions, hiddens=(256, 256))
     bs = 4096
@@ -117,22 +131,27 @@ def main():
     result["ppo"] = bench_learner(
         ppo, batches, bs * 4, args.duration,  # 4 epochs over the batch
         update_kw=dict(num_epochs=4, minibatch_size=1024))
-    print(json.dumps({"ppo": result["ppo"]}), file=sys.stderr)
+    print(json.dumps({"ppo": result["ppo"]}), file=sys.stderr,
+          flush=True)
+    flush()
 
     T, N = 64, 64
     impala = ImpalaLearner(obs_dim, num_actions, hiddens=(256, 256))
     batches = [_fake_impala_batch(obs_dim, num_actions, T, N, seed=s)
                for s in range(4)]
     result["impala"] = bench_learner(impala, batches, T * N, args.duration)
-    print(json.dumps({"impala": result["impala"]}), file=sys.stderr)
+    print(json.dumps({"impala": result["impala"]}), file=sys.stderr,
+          flush=True)
+    flush()
 
     appo = APPOLearner(obs_dim, num_actions, hiddens=(256, 256))
     result["appo"] = bench_learner(appo, batches, T * N, args.duration)
-    print(json.dumps({"appo": result["appo"]}), file=sys.stderr)
+    print(json.dumps({"appo": result["appo"]}), file=sys.stderr,
+          flush=True)
+    flush()
 
     # ---- end-to-end (host-CPU-bound rollouts; context, not the target)
     if not args.skip_end_to_end:
-        import os
         os.environ.setdefault("TPU_CHIPS", "0")
         import ray_tpu
 
@@ -152,13 +171,13 @@ def main():
                 args.duration)
         finally:
             ray_tpu.shutdown()
+        flush()
 
     result["reference_context"] = (
         "reference GPU learner throughput for PPO/IMPALA Atari is "
         "O(10k-50k) env-steps/s per GPU (release/rllib_tests); row-3 "
         "target is the learner_env_steps_per_s fields")
-    with open(args.out, "w") as f:
-        json.dump(result, f, indent=1)
+    flush()
     print(json.dumps(result))
 
 

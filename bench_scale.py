@@ -192,8 +192,7 @@ def main():
         "note": "control-plane rates on one host; reference envelope "
                 "(2k nodes / 40k actors) is a 4096-core fleet number",
     }
-    with open(args.out, "w") as f:
-        json.dump(result, f, indent=1)
+    flush()
     print(json.dumps(result))
 
 

@@ -14,6 +14,8 @@ Algorithms are Tune Trainables, so ``Tuner(PPO, param_space=...)`` works.
 
 from .a2c import A2C, A2CConfig, A2CLearner
 from .algorithm import Algorithm, AlgorithmConfig
+from .alpha_zero import (MCTS, AlphaZero, AlphaZeroConfig,
+                         AlphaZeroLearner, TicTacToe)
 from .apex_dqn import ApexDQN, ApexDQNConfig, ReplayShard
 from .ars import ARS, ARSConfig
 from .catalog import (ModelSpec, get_model, gru_forward, gru_unroll,
@@ -66,6 +68,8 @@ __all__ = [
     "R2D2", "R2D2Config", "R2D2Learner", "R2D2RolloutWorker",
     "SequenceReplay", "ModelSpec", "get_model", "register_custom_model",
     "init_gru", "gru_forward", "gru_unroll",
+    "AlphaZero", "AlphaZeroConfig", "AlphaZeroLearner", "MCTS",
+    "TicTacToe",
 ]
 
 from ray_tpu.usage_stats import record_library_usage as _rlu

@@ -57,6 +57,7 @@ class GrpcProxy:
         self._apps_at = 0.0
         self._handles: dict = {}
         self._refresh_lock = threading.Lock()
+        self._loaded = False  # one cold-start route fetch has completed
         # rejection must be prompt: each handler can block its executor
         # thread up to the request timeout, so the RPC cap is tied to
         # the thread count (workers running + workers queued) — not an
@@ -142,14 +143,13 @@ class GrpcProxy:
         cross the TTL together (the HTTP proxy learned this the hard
         way — the per-request controller RPC dominated proxy latency)."""
         if time.monotonic() - self._apps_at > _ROUTES_TTL_S:
-            # cold start (never loaded) must BLOCK on the lock: serving
-            # the initial empty table would turn a racing first request
-            # into a spurious NOT_FOUND, which gRPC clients don't retry.
-            # After first load, losers of the acquire race serve the
-            # (possibly stale) table instead of stacking up behind the
-            # RPC.
-            never_loaded = self._apps_at == 0.0
-            if self._refresh_lock.acquire(blocking=never_loaded):
+            # cold start (no completed load attempt) must BLOCK on the
+            # lock: serving the initial empty table would turn a racing
+            # first request into a spurious NOT_FOUND, which gRPC
+            # clients don't retry. Afterwards, losers of the acquire
+            # race serve the (possibly stale) table instead of stacking
+            # up behind the RPC.
+            if self._refresh_lock.acquire(blocking=not self._loaded):
                 try:
                     if time.monotonic() - self._apps_at > _ROUTES_TTL_S:
                         routes = ray_tpu.get(
@@ -162,6 +162,12 @@ class GrpcProxy:
                 except Exception:  # noqa: BLE001 — keep serving stale
                     pass
                 finally:
+                    # loaded marks "a cold-start attempt COMPLETED", not
+                    # "it succeeded": if the controller is unreachable,
+                    # later requests must fail fast on the empty table
+                    # rather than serially repeating a 10s blocking RPC
+                    # from every executor thread
+                    self._loaded = True
                     self._refresh_lock.release()
         return self._apps
 

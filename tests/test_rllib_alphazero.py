@@ -1,0 +1,133 @@
+"""AlphaZero tests: game rules, MCTS tactics, learning on TicTacToe.
+
+Ref analog: rllib/algorithms/alpha_zero tests — toy-env self-play
+learning smoke tests rather than full-scale Go.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.alpha_zero import (MCTS, AlphaZero, AlphaZeroConfig,
+                                      AlphaZeroLearner, TicTacToe,
+                                      _init_net, _np_forward)
+
+
+class TestTicTacToe:
+    def test_win_detection(self):
+        s = TicTacToe.initial()
+        # player A: 0, 1, 2 top row; B elsewhere
+        s = TicTacToe.step(s, 0)          # A plays 0 -> B to move
+        s = TicTacToe.step(s, 3)          # B plays 3 -> A to move
+        s = TicTacToe.step(s, 1)
+        s = TicTacToe.step(s, 4)
+        s = TicTacToe.step(s, 2)          # A completes the row
+        # from the perspective of the player to move (B), previous
+        # player won -> -1
+        assert TicTacToe.outcome(s) == -1.0
+
+    def test_draw(self):
+        s = TicTacToe.initial()
+        for a in (0, 1, 2, 4, 3, 5, 7, 6, 8):
+            assert TicTacToe.outcome(s) is None
+            s = TicTacToe.step(s, a)
+        assert TicTacToe.outcome(s) == 0.0
+
+    def test_encode_perspective(self):
+        s = TicTacToe.step(TicTacToe.initial(), 4)
+        e = TicTacToe.encode(s)
+        assert e.shape == (18,)
+        assert e[4] == 0 and e[9 + 4] == 1  # opponent stone at center
+
+
+class TestMCTS:
+    def _weights(self):
+        return _init_net(np.random.default_rng(0), 18, 9, (32,))
+
+    def test_finds_immediate_win(self):
+        # X to move with two in a row -> MCTS must pick the winning cell
+        s = np.zeros(9, np.int8)
+        s[0] = s[1] = 1     # own stones
+        s[3] = s[4] = -1    # opponent
+        mcts = MCTS(TicTacToe, self._weights(), sims=64, noise_frac=0.0)
+        pi = mcts.policy(s, temperature=1e-4)
+        assert int(pi.argmax()) == 2
+
+    def test_blocks_immediate_loss(self):
+        # opponent threatens 6,7,8; only blocking at 8 avoids the loss
+        s = np.zeros(9, np.int8)
+        s[6] = s[7] = -1
+        s[0] = 1
+        mcts = MCTS(TicTacToe, self._weights(), sims=128, noise_frac=0.0)
+        pi = mcts.policy(s, temperature=1e-4)
+        assert int(pi.argmax()) == 8
+
+    def test_policy_sums_to_one(self):
+        mcts = MCTS(TicTacToe, self._weights(), sims=16)
+        pi = mcts.policy(TicTacToe.initial())
+        assert pi.shape == (9,)
+        assert abs(pi.sum() - 1.0) < 1e-5
+
+
+class TestLearner:
+    def test_loss_decreases_on_fixed_batch(self):
+        ln = AlphaZeroLearner(18, 9, hiddens=(32,), lr=5e-3)
+        rng = np.random.default_rng(0)
+        obs = rng.normal(size=(64, 18)).astype(np.float32)
+        pi = rng.dirichlet(np.ones(9), size=64).astype(np.float32)
+        z = rng.choice([-1.0, 0.0, 1.0], 64).astype(np.float32)
+        first = ln.update(obs, pi, z)["total_loss"]
+        for _ in range(30):
+            last = ln.update(obs, pi, z)["total_loss"]
+        assert last < first
+
+    def test_numpy_and_jax_forward_agree(self):
+        ln = AlphaZeroLearner(18, 9, hiddens=(32,))
+        w = ln.get_weights()
+        obs = np.random.default_rng(1).normal(size=18).astype(np.float32)
+        p, v = _np_forward(w, obs)
+        assert abs(p.sum() - 1.0) < 1e-5 and -1 <= v <= 1
+
+
+@pytest.mark.slow
+class TestAlphaZeroLearning:
+    def test_beats_random_after_training(self, ray_start):
+        algo = (AlphaZeroConfig()
+                .rollouts(num_rollout_workers=2)
+                .training(mcts_sims=32, games_per_worker=6,
+                          train_epochs=6, lr=1e-2)
+                .debugging(seed=7)
+                .build())
+        try:
+            for _ in range(6):
+                metrics = algo.step()
+            assert metrics["replay_size"] > 100
+
+            # evaluate: trained MCTS agent vs uniform-random opponent
+            rng = np.random.default_rng(3)
+            results = []
+            for g in range(20):
+                s = TicTacToe.initial()
+                agent_to_move = (g % 2 == 0)  # alternate first player
+                sign = 1.0 if agent_to_move else -1.0
+                while True:
+                    term = TicTacToe.outcome(s)
+                    if term is not None:
+                        # term is from the mover's perspective; convert
+                        # to the AGENT's perspective
+                        results.append(
+                            term if agent_to_move else -term)
+                        break
+                    if agent_to_move:
+                        a = algo.compute_single_action(s, sims=32)
+                    else:
+                        a = int(rng.choice(
+                            np.flatnonzero(TicTacToe.legal(s))))
+                    s = TicTacToe.step(s, a)
+                    agent_to_move = not agent_to_move
+            score = float(np.mean(results))  # win=+1, draw=0, loss=-1
+            # an untrained/random agent scores ~0 vs random; tactical
+            # MCTS + a trained net must clearly dominate
+            assert score > 0.5, f"agent score vs random: {score}"
+        finally:
+            algo.cleanup()
