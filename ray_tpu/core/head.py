@@ -438,9 +438,18 @@ class Head:
             return
         try:
             handler(self, conn, rid, *msg[2:])
+        except P.ConnectionLost:
+            # the requester vanished mid-request (e.g. a worker killed
+            # during a shutdown wave): there is nobody to answer and
+            # nothing to fix — replying the error would just raise
+            # ConnectionLost again on the same dead socket
+            pass
         except Exception as e:  # noqa: BLE001
             if rid > 0:
-                conn.reply_error(rid, e)
+                try:
+                    conn.reply_error(rid, e)
+                except P.ConnectionLost:
+                    pass
             else:
                 import traceback
 
