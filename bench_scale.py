@@ -89,11 +89,19 @@ def bench_many_actors(n: int) -> dict:
     pids = ray_tpu.get(pings, timeout=3600)
     dt = time.perf_counter() - t0
     assert len(set(pids)) == n, "actors must be distinct processes"
+    result = {"actors": n, "submit_seconds": round(create_dt, 3),
+              "seconds_to_all_ready": round(dt, 3),
+              "actors_per_s": round(n / dt, 1)}
+    # cleanup is NOT part of the measurement and must not lose it: a
+    # single kill RPC timing out against a head that is draining 1k
+    # worker processes previously crashed the phase after the data was
+    # already in hand
     for a in actors:
-        ray_tpu.kill(a)
-    return {"actors": n, "submit_seconds": round(create_dt, 3),
-            "seconds_to_all_ready": round(dt, 3),
-            "actors_per_s": round(n / dt, 1)}
+        try:
+            ray_tpu.kill(a)
+        except Exception:  # noqa: BLE001
+            pass
+    return result
 
 
 def bench_many_pgs(n: int) -> dict:

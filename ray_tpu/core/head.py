@@ -438,12 +438,23 @@ class Head:
             return
         try:
             handler(self, conn, rid, *msg[2:])
-        except P.ConnectionLost:
-            # the requester vanished mid-request (e.g. a worker killed
-            # during a shutdown wave): there is nobody to answer and
-            # nothing to fix — replying the error would just raise
-            # ConnectionLost again on the same dead socket
-            pass
+        except P.ConnectionLost as e:
+            # Swallow ONLY "the requester itself vanished mid-request"
+            # (e.g. a worker killed during a shutdown wave): nobody to
+            # answer, and replying would raise on the same dead socket.
+            # A ConnectionLost from some OTHER peer's socket inside a
+            # handler's fan-out is a real handler failure — surface it
+            # to the requester like any other exception.
+            if e.conn is not None and e.conn is not conn:
+                if rid > 0:
+                    try:
+                        conn.reply_error(rid, e)
+                    except P.ConnectionLost:
+                        pass
+                else:
+                    import traceback
+
+                    traceback.print_exc()
         except Exception as e:  # noqa: BLE001
             if rid > 0:
                 try:
@@ -838,7 +849,11 @@ class Head:
                 info.pending_get_replies.clear()
                 state, payload = "ALIVE", info.listen_addr
         for wconn, wrid in waiters:
-            wconn.reply(wrid, state, payload, msg_type=P.GET_ACTOR_REPLY)
+            try:
+                wconn.reply(wrid, state, payload,
+                            msg_type=P.GET_ACTOR_REPLY)
+            except P.ConnectionLost:
+                pass  # that waiter died; the rest must still hear
         self._publish(f"actor:{w.actor_id.hex()}", dumps((state, payload)))
 
     def _h_actor_dead(self, conn, rid, actor_id_bin, cause):
@@ -878,7 +893,11 @@ class Head:
             info.pending_get_replies.clear()
             self._release_actor_name(info)
         for wconn, wrid in waiters:
-            wconn.reply(wrid, "DEAD", cause, msg_type=P.GET_ACTOR_REPLY)
+            try:
+                wconn.reply(wrid, "DEAD", cause,
+                            msg_type=P.GET_ACTOR_REPLY)
+            except P.ConnectionLost:
+                pass  # that waiter died; the rest must still hear
         self._publish(f"actor:{info.actor_id.hex()}", dumps(("DEAD", cause)))
 
     def _release_actor_name(self, info: ActorInfo):
@@ -1151,8 +1170,11 @@ class Head:
             waiters = list(loc.waiters)
             loc.waiters.clear()
         for wconn, wrid in waiters:
-            wconn.reply(wrid, node_idx, size, "",
-                        msg_type=P.OBJECT_LOCATE_REPLY)
+            try:
+                wconn.reply(wrid, node_idx, size, "",
+                            msg_type=P.OBJECT_LOCATE_REPLY)
+            except P.ConnectionLost:
+                pass  # that waiter died; the rest must still hear
         self._maybe_spill(node_idx)
 
     def _h_object_locate(self, conn, rid, oid_bin, block):

@@ -117,7 +117,14 @@ _RAW_BIT = 1 << 63
 
 
 class ConnectionLost(Exception):
-    pass
+    """Raised by writes/calls on a dead connection. ``conn`` identifies
+    WHICH connection died — a handler touching several peers needs it to
+    tell "my requester vanished" apart from "some third party's socket
+    broke mid-fanout" (the latter must not abort the handler)."""
+
+    def __init__(self, msg, conn=None):
+        super().__init__(msg)
+        self.conn = conn
 
 
 class Connection:
@@ -154,11 +161,11 @@ class Connection:
         data = _LEN.pack(len(payload)) + payload
         with self._wlock:
             if self.closed:
-                raise ConnectionLost(self.peer)
+                raise ConnectionLost(self.peer, conn=self)
             try:
                 self._send_all(data)
             except OSError as e:
-                raise ConnectionLost(f"{self.peer}: {e}") from e
+                raise ConnectionLost(f"{self.peer}: {e}", conn=self) from e
 
     def _send_all(self, data: bytes, stall_timeout: float = 60.0):
         """sendall that survives a non-blocking socket (IOLoop registration
@@ -216,13 +223,13 @@ class Connection:
         header = pickle.dumps((msg_type, 0, *fields), protocol=5)
         with self._wlock:
             if self.closed:
-                raise ConnectionLost(self.peer)
+                raise ConnectionLost(self.peer, conn=self)
             try:
                 self._send_all(_LEN.pack(len(header)) + header)
                 self._send_all(_LEN.pack(n | _RAW_BIT))
                 self._send_all(raw)
             except OSError as e:
-                raise ConnectionLost(f"{self.peer}: {e}") from e
+                raise ConnectionLost(f"{self.peer}: {e}", conn=self) from e
 
     def call(self, msg_type: int, *fields, timeout: Optional[float] = None):
         """Send a request and block for its reply; returns reply fields."""

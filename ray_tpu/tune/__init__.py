@@ -16,6 +16,7 @@ from .schedulers import (
     PopulationBasedTraining,
     TrialScheduler,
 )
+from .external_search import OptunaSearch
 from .search import (
     BasicVariantGenerator,
     RandomSearch,
@@ -46,6 +47,7 @@ __all__ = [
     "ASHAScheduler", "HyperBandScheduler", "MedianStoppingRule",
     "PopulationBasedTraining",
     "Searcher", "BasicVariantGenerator", "RandomSearch", "TPESearcher",
+    "OptunaSearch",
     "choice", "uniform", "loguniform", "quniform", "randint", "qrandint",
     "grid_search", "sample_from",
 ]
