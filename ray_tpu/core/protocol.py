@@ -126,6 +126,11 @@ class ConnectionLost(Exception):
         super().__init__(msg)
         self.conn = conn
 
+    def __reduce__(self):
+        # conn holds a live socket + locks: unpicklable, and meaningless
+        # in another process anyway — error replies ship the message only
+        return (ConnectionLost, (str(self),))
+
 
 class Connection:
     """A framed, thread-safe duplex connection.
@@ -332,7 +337,7 @@ class Connection:
             pending = list(self._pending.values())
             self._pending.clear()
         for w in pending:
-            w.error = ConnectionLost(self.peer)
+            w.error = ConnectionLost(self.peer, conn=self)
             w.event.set()
         if self.on_close:
             try:

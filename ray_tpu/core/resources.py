@@ -129,13 +129,30 @@ class TpuTopology:
 
 @dataclass
 class NodeResources:
-    """Total and available resources on one node, plus labels."""
+    """Total and available resources on one node, plus labels.
+
+    ``version`` increments on every availability change; the native
+    scheduler core uses it to re-sync only dirty nodes before a
+    placement decision."""
 
     node_id: object = None
     total: ResourceSet = field(default_factory=ResourceSet)
     available: ResourceSet = field(default_factory=ResourceSet)
     labels: Dict[str, str] = field(default_factory=dict)
     tpu: Optional[TpuTopology] = None
+    version: int = 0
+    # change listeners (native scheduler dirty tracking); excluded from
+    # pickling — a node's resources cross the wire at registration
+    listeners: list = field(default_factory=list, repr=False,
+                            compare=False)
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["listeners"] = []
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
 
     def is_feasible(self, request: ResourceSet) -> bool:
         return self.total.covers(request)
@@ -145,13 +162,22 @@ class NodeResources:
 
     def allocate(self, request: ResourceSet):
         self.available = self.available.subtract(request)
+        self.version += 1
+        for cb in self.listeners:
+            cb()
 
     def release(self, request: ResourceSet):
-        self.available = self.available.add(request)
-        # Guard against double-release drifting above total.
-        for k in list(self.available.names()):
-            if self.available.get_fp(k) > self.total.get_fp(k):
+        # Validate BEFORE assigning: a double-release must not leave the
+        # inflated availability behind (with version/listeners skipped,
+        # the native scheduler table would silently disagree too).
+        released = self.available.add(request)
+        for k in released.names():
+            if released.get_fp(k) > self.total.get_fp(k):
                 raise ValueError(f"Released more {k} than total on node")
+        self.available = released
+        self.version += 1
+        for cb in self.listeners:
+            cb()
 
     def utilization(self) -> float:
         """Max utilization across critical resources — drives hybrid policy."""

@@ -16,6 +16,7 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 
 TARGETS = {
     "libshm_store.so": ["shm_store.cc"],
+    "libsched_core.so": ["sched_core.cc"],
 }
 
 # standalone executables (the C++ task-submission frontend)
