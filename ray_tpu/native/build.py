@@ -55,6 +55,21 @@ def build_binary(name: str, force: bool = False) -> str:
     return out
 
 
+def build_sanitized(sources, out_name: str, sanitizer: str) -> str:
+    """Compile an instrumented test binary (ref: .bazelrc asan/tsan
+    configs); ``sanitizer`` is "thread" or "address". Returns its path.
+    Sanitized binaries link the C++ sources directly (no .so) so the
+    instrumentation covers everything."""
+    out = os.path.join(_DIR, out_name)
+    srcs = [os.path.join(_DIR, s) for s in sources]
+    if _stale(out, srcs):
+        subprocess.run(
+            ["g++", "-O1", "-g", "-std=c++17", f"-fsanitize={sanitizer}",
+             "-fno-omit-frame-pointer", "-o", out, *srcs, *LDFLAGS],
+            check=True, cwd=_DIR)
+    return out
+
+
 def lib_path(name: str) -> str:
     build()
     return os.path.join(_DIR, name)

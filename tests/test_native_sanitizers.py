@@ -1,0 +1,37 @@
+"""Sanitizer-instrumented stress tests for the native components.
+
+Ref analog: the reference's asan/tsan bazel configs (.bazelrc:95-123)
+running the C++ unit tests instrumented (SURVEY.md §4.7). Here the shm
+store — the one component shared by every process on a node — is
+hammered by 8 threads + an eviction thread under ThreadSanitizer and
+AddressSanitizer; the sanitizers abort non-zero on any finding.
+"""
+
+import subprocess
+
+import pytest
+
+from ray_tpu.native.build import build_sanitized
+
+
+def _toolchain_has(sanitizer: str) -> bool:
+    probe = subprocess.run(
+        ["g++", f"-fsanitize={sanitizer}", "-x", "c++", "-", "-o",
+         "/dev/null"],
+        input=b"int main(){return 0;}", capture_output=True)
+    return probe.returncode == 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("sanitizer", ["thread", "address"])
+def test_store_stress_under_sanitizer(sanitizer):
+    if not _toolchain_has(sanitizer):
+        pytest.skip(f"toolchain lacks -fsanitize={sanitizer}")
+    binary = build_sanitized(
+        ["store_stress_test.cc", "shm_store.cc"],
+        f"store_stress_{sanitizer}", sanitizer)
+    proc = subprocess.run([binary], capture_output=True, text=True,
+                          timeout=600)
+    assert proc.returncode == 0, (
+        f"{sanitizer} sanitizer reported:\n{proc.stdout}\n{proc.stderr}")
+    assert "ok used=" in proc.stdout
