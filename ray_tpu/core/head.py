@@ -1505,6 +1505,11 @@ class Head:
                     if loc.node_idx >= 0 or loc.spilled_path]
             elif kind == "metrics":
                 rows = list(self.metrics.values())
+            elif kind == "io_loop":
+                # head event-loop lag (analog: the reference's
+                # instrumented_io_context / event_stats.h per-handler
+                # timing surfaced through the debug state endpoints)
+                rows = [dict(loop=self.io.name, **self.io.stats())]
             elif kind == "task_events":
                 # raw transition log (timeline/tracing export)
                 rows = [{

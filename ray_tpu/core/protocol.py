@@ -362,9 +362,15 @@ class IOLoop:
     (src/ray/common/asio/instrumented_io_context.h).
     """
 
+    # a handler occupying the IO thread longer than this is logged —
+    # the analog of the reference's event-loop lag tracking (every
+    # handler on instrumented_io_context is timed; event_stats.h)
+    SLOW_HANDLER_S = 0.1
+
     def __init__(self, name: str = "io"):
         import selectors
 
+        self.name = name
         self.sel = selectors.DefaultSelector()
         self._lock = threading.Lock()
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
@@ -373,6 +379,12 @@ class IOLoop:
         self._wakeup_r.setblocking(False)
         self.sel.register(self._wakeup_r, 1, ("wakeup", None, None))
         self._started = False
+        # loop-lag accounting, exposed via stats(): total busy seconds,
+        # handled events, count + worst of slow handler episodes
+        self._busy_s = 0.0
+        self._events = 0
+        self._slow_events = 0
+        self._max_handler_s = 0.0
 
     def start(self):
         if not self._started:
@@ -415,6 +427,7 @@ class IOLoop:
                 continue
             for key, _ in events:
                 kind, cb, conn = key.data
+                t0 = time.perf_counter()
                 if kind == "wakeup":
                     try:
                         self._wakeup_r.recv(4096)
@@ -428,6 +441,28 @@ class IOLoop:
                         pass
                 elif kind == "conn":
                     self._service_conn(key.fileobj, cb, conn)
+                dt = time.perf_counter() - t0
+                self._busy_s += dt
+                self._events += 1
+                if dt > self._max_handler_s:
+                    self._max_handler_s = dt
+                if dt > self.SLOW_HANDLER_S:
+                    # every connection on this loop stalled behind this
+                    # handler — the single-threaded-loop failure mode the
+                    # reference instruments (instrumented_io_context)
+                    self._slow_events += 1
+                    import sys
+
+                    print(f"[ray_tpu] io loop '{self.name}' handler "
+                          f"({kind}) blocked the loop {dt * 1e3:.0f} ms",
+                          file=sys.stderr)
+
+    def stats(self) -> dict:
+        """Loop-lag counters (analog: event_stats.h per-handler stats)."""
+        return {"events": self._events,
+                "busy_s": round(self._busy_s, 3),
+                "slow_events": self._slow_events,
+                "max_handler_s": round(self._max_handler_s, 4)}
 
     def _service_conn(self, sock, on_message, conn: Connection):
         try:

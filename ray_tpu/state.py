@@ -75,6 +75,14 @@ def list_tasks(filters=None, limit: int = _DEFAULT_LIMIT):
     return _apply_filters(_query("tasks", limit), filters)
 
 
+def io_loop_stats() -> List[Dict[str, Any]]:
+    """Head event-loop lag counters (analog: the reference's
+    instrumented_io_context / event_stats.h per-handler timing):
+    events handled, busy seconds, slow-handler episodes, worst
+    handler time."""
+    return _query("io_loop", 10)
+
+
 def summarize_tasks(limit: int = 10_000) -> Dict[str, Any]:
     """Ref parity: ray.util.state.summarize_tasks (api.py:1009): count of
     tasks by (name, state)."""

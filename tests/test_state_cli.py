@@ -98,6 +98,23 @@ def test_list_actors_and_objects(ray_start):
     del big
 
 
+def test_io_loop_stats(ray_start):
+    """Event-loop lag counters (analog: instrumented_io_context /
+    event_stats.h) are queryable and advance with traffic."""
+    @ray_tpu.remote
+    def noop():
+        return 0
+
+    ray_tpu.get([noop.remote() for _ in range(5)], timeout=60)
+    (row,) = state_api.io_loop_stats()
+    assert row["loop"] == "head-io"
+    assert row["events"] > 0 and row["busy_s"] >= 0
+    before = row["events"]
+    ray_tpu.get([noop.remote() for _ in range(5)], timeout=60)
+    (row2,) = state_api.io_loop_stats()
+    assert row2["events"] > before
+
+
 def test_cli_status_and_list_from_subprocess(ray_start):
     """`python -m ray_tpu status/list --address ...` attaches to a live
     head from another process (reference: `ray status` against a running
