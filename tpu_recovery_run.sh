@@ -1,0 +1,21 @@
+#!/bin/bash
+# Fire the full TPU evidence battery the moment the tunnel recovers.
+# Order matters: most valuable artifact first, in case it wedges again.
+set -x
+cd /root/repo
+rm -f /dev/shm/rtpu_*
+
+# 1) serving artifact: continuous vs cohort + proxy (SERVE_BENCH_r5.json)
+timeout 900 python bench_serve.py --model llama3-1b --duration 30 \
+    --decode-chunk 16 --max-inflight 4 \
+    --out SERVE_BENCH_r5.json 2>&1 | tail -5
+
+# 2) slot-scaling experiment: decode is weight-streaming bound, so
+#    doubling slots should raise tokens/s without hurting latency
+timeout 600 python bench_serve.py --model llama3-1b --duration 12 \
+    --slots 16 --decode-chunk 16 --max-inflight 4 --skip-cohort \
+    --proxy-duration 1 --out /tmp/serve_slots16.json 2>&1 | \
+    grep '"engine"' | tail -1
+
+# 3) flagship MFU sanity (the driver runs the full ladder at round end)
+timeout 900 python bench.py 2>&1 | tail -3
