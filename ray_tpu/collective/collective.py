@@ -86,6 +86,12 @@ class Rendezvous:
         if kind == "broadcast":
             return parts[src_rank]
         ordered = [parts[r] for r in sorted(parts)]
+        if kind == "exchange":
+            # control-plane-only round for the object-plane transport:
+            # payloads are OBJECT REFS (+ small metadata), never tensor
+            # bytes — every rank gets the full rank->payload picture and
+            # the bulk data moves store-to-store
+            return ordered
         if kind == "allgather":
             return ordered
         if kind == "allreduce" or kind == "reduce":
@@ -206,23 +212,141 @@ def _run(kind: str, group_name: str, payload, **kw):
         timeout=kw.get("timeout", 300.0) + 30)
 
 
-def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+# Payloads at or above this ride the OBJECT PLANE (store-to-store
+# transfer) with the coordinator carrying refs only; below it, inline
+# through the coordinator. The choice is PER RANK and cannot
+# desynchronize the group: every collective runs a fixed number of
+# "exchange" rendezvous rounds regardless of transport, and each round's
+# payload self-describes as an inline value or a (nested) ref that the
+# receiving ranks resolve. Override per call with transport=.
+OBJECT_TRANSPORT_THRESHOLD = 256 * 1024
+
+_TRANSPORTS = ("auto", "inline", "object")
+
+
+def _use_object_plane(arr: np.ndarray, transport: str) -> bool:
+    if transport not in _TRANSPORTS:
+        raise ValueError(f"transport must be one of {_TRANSPORTS}, "
+                         f"got {transport!r}")
+    if transport == "inline":
+        return False
+    if transport == "object":
+        return True
+    return arr.nbytes >= OBJECT_TRANSPORT_THRESHOLD
+
+
+def _wrap(arr: Optional[np.ndarray], use_object: bool) -> Optional[dict]:
+    """Self-describing round payload: inline value or nested ref (a
+    BARE ref argument would be resolved to its value at the callee —
+    exactly the byte funnel the object path exists to avoid)."""
+    if arr is None:
+        return None
+    if use_object:
+        import ray_tpu
+
+        return {"ref": [ray_tpu.put(np.ascontiguousarray(arr))]}
+    return {"val": np.asarray(arr)}
+
+
+def _unwrap(payload: dict) -> np.ndarray:
+    if "val" in payload:
+        return payload["val"]
+    import ray_tpu
+
+    return np.asarray(ray_tpu.get(payload["ref"][0], timeout=300))
+
+
+def _allreduce_exchange(arr: np.ndarray, st: _GroupState, op: str,
+                        use_object: bool):
+    """Reduce-scatter + allgather by slices over TWO exchange rounds.
+
+    Ring-class asymptotics without per-step rendezvous chatter: each
+    rank publishes W slices of its flattened tensor (refs when sized,
+    inline when small), the first round spreads the W x W payload grid,
+    every rank resolves COLUMN r (one slice from each peer, ~nbytes/W
+    each, sources spread across all stores), reduces it, publishes the
+    reduced slice, and the second round lets everyone assemble the
+    result — ~2x nbytes moved per rank, none of it through the
+    coordinator when refs are used. This replaces funneling
+    O(world x nbytes) of tensor bytes through one actor (round-4
+    review, Weak #7); the reference's analog is the NCCL ring under
+    collective.py:258. The round structure is IDENTICAL for both
+    transports, so ranks choosing differently still rendezvous."""
+    W = st.world_size
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    slices = np.array_split(flat, W)
+    mine = {"meta": (arr.shape, str(arr.dtype)),
+            "slices": [_wrap(s, use_object) for s in slices]}
+    grid = _run("exchange", st.name, mine)  # [rank] -> payload dict
+    metas = {p["meta"] for p in grid}
+    if len(metas) != 1:
+        raise ValueError(
+            f"allreduce requires identical shape/dtype on every rank; "
+            f"got {sorted(metas)}")
+    r = st.rank
+    column = [_unwrap(grid[q]["slices"][r]) for q in range(W)]
+    reduced = _REDUCE_OPS[op](column)
+    round2 = _run("exchange", st.name,
+                  _wrap(reduced, use_object))
+    pieces = [np.asarray(_unwrap(p)).reshape(-1) for p in round2]
+    out = np.concatenate(pieces)
+    return out.reshape(arr.shape).astype(arr.dtype, copy=False)
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum",
+              transport: str = "auto"):
     """Reduce across the group; returns the reduced array (and copies it
     into ``tensor`` in place when it's a writable ndarray, matching the
-    reference's in-place contract, collective.py:258)."""
-    result = _run("allreduce", group_name, np.asarray(tensor), op=op)
+    reference's in-place contract, collective.py:258).
+
+    ``transport``: "auto" (object plane for payloads >= 256 KiB),
+    "inline" (through the coordinator), "object" (force object plane).
+    All ranks must pass identically-shaped/dtyped tensors (validated).
+    """
+    arr = np.asarray(tensor)
+    st = _get(group_name)
+    if st.world_size > 1:
+        result = _allreduce_exchange(
+            arr, st, op, _use_object_plane(arr, transport))
+    else:
+        _use_object_plane(arr, transport)  # validate the argument
+        result = arr
     if isinstance(tensor, np.ndarray) and tensor.flags.writeable:
         np.copyto(tensor, result)
     return result
 
 
-def allgather(tensor, group_name: str = "default") -> List[Any]:
-    return _run("allgather", group_name, np.asarray(tensor))
+def allgather(tensor, group_name: str = "default",
+              transport: str = "auto") -> List[Any]:
+    arr = np.asarray(tensor)
+    st = _get(group_name)
+    if st.world_size == 1:
+        _use_object_plane(arr, transport)
+        return [arr]
+    parts = _run("exchange", group_name,
+                 _wrap(arr, _use_object_plane(arr, transport)))
+    return [_unwrap(p) for p in parts]
 
 
-def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
-    result = _run("broadcast", group_name, np.asarray(tensor),
-                  src_rank=src_rank)
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
+              transport: str = "auto"):
+    """One exchange round for any world size: only the SOURCE's local
+    tensor decides the transport (receivers pass placeholders whose
+    size must not influence the round structure), so ranks can never
+    rendezvous on mismatched kinds."""
+    arr = np.asarray(tensor)
+    st = _get(group_name)
+    if st.world_size > 1:
+        if st.rank == src_rank:
+            mine = _wrap(arr, _use_object_plane(arr, transport))
+        else:
+            _use_object_plane(arr, transport)  # validate the argument
+            mine = None
+        parts = _run("exchange", group_name, mine)
+        result = arr if st.rank == src_rank else _unwrap(parts[src_rank])
+    else:
+        _use_object_plane(arr, transport)
+        result = arr
     if isinstance(tensor, np.ndarray) and tensor.flags.writeable:
         np.copyto(tensor, result)
     return result

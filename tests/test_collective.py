@@ -57,6 +57,32 @@ class _Member:
         collective.barrier(group_name=group_name)
         return True
 
+    def do_big_allreduce(self, group_name, n, transport):
+        from ray_tpu import collective
+
+        out = collective.allreduce(
+            np.full(n, self.rank + 1.0, np.float32),
+            group_name=group_name, transport=transport)
+        return float(out[0]), float(out[-1]), out.shape
+
+    def do_big_broadcast(self, group_name, n):
+        from ray_tpu import collective
+
+        val = (np.arange(n, dtype=np.float32) if self.rank == 0
+               else np.zeros(n, np.float32))
+        out = collective.broadcast(val, src_rank=0,
+                                   group_name=group_name,
+                                   transport="object")
+        return float(out[1]), float(out[-1])
+
+    def do_big_allgather(self, group_name, n):
+        from ray_tpu import collective
+
+        outs = collective.allgather(
+            np.full(n, float(self.rank), np.float32),
+            group_name=group_name, transport="object")
+        return [float(o[0]) for o in outs]
+
 
 class TestCollective:
     def test_allreduce_broadcast_allgather_barrier(self, rt):
@@ -87,6 +113,67 @@ class TestCollective:
 
         assert all(ray_tpu.get(
             [m.do_barrier.remote("g1") for m in members], timeout=120))
+
+    def test_object_plane_collectives(self, rt):
+        """Sized payloads ride the object plane (reduce-scatter +
+        allgather by slices; coordinator sees refs only) and must agree
+        numerically with the inline path — round-4 Weak #7."""
+        from ray_tpu import collective
+
+        world = 3
+        n = 200_000  # 800 KB float32: above OBJECT_TRANSPORT_THRESHOLD
+        cls = ray_tpu.remote(_Member)
+        members = [cls.options(num_cpus=0).remote(r, world)
+                   for r in range(world)]
+        collective.create_collective_group(
+            members, world, list(range(world)), group_name="gbig")
+
+        for transport in ("object", "inline"):
+            outs = ray_tpu.get(
+                [m.do_big_allreduce.remote("gbig", n, transport)
+                 for m in members], timeout=180)
+            for first, last, shape in outs:
+                assert first == last == 6.0  # 1+2+3
+                assert shape == (n,)
+
+        outs = ray_tpu.get(
+            [m.do_big_broadcast.remote("gbig", n) for m in members],
+            timeout=180)
+        for second, last in outs:
+            assert second == 1.0 and last == float(n - 1)
+
+        outs = ray_tpu.get(
+            [m.do_big_allgather.remote("gbig", n) for m in members],
+            timeout=180)
+        for firsts in outs:
+            assert firsts == [0.0, 1.0, 2.0]
+
+    def test_mixed_transport_ranks_interoperate(self, rt):
+        """Ranks choosing DIFFERENT transports must still rendezvous:
+        the round structure is transport-independent and payloads
+        self-describe (inline value vs nested ref)."""
+        from ray_tpu import collective
+
+        world = 2
+        cls = ray_tpu.remote(_Member)
+        members = [cls.options(num_cpus=0).remote(r, world)
+                   for r in range(world)]
+        collective.create_collective_group(
+            members, world, [0, 1], group_name="gmix")
+        outs = ray_tpu.get(
+            [members[0].do_big_allreduce.remote("gmix", 1000, "inline"),
+             members[1].do_big_allreduce.remote("gmix", 1000, "object")],
+            timeout=120)
+        for first, last, shape in outs:
+            assert first == last == 3.0 and shape == (1000,)
+
+    def test_invalid_transport_rejected(self, rt):
+        from ray_tpu import collective
+
+        collective.init_collective_group(1, 0, group_name="gsolo")
+        with pytest.raises(ValueError, match="transport"):
+            collective.allreduce(np.ones(4), group_name="gsolo",
+                                 transport="Object")
 
     def test_two_member_sum(self, rt):
         from ray_tpu import collective
