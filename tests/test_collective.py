@@ -128,25 +128,32 @@ class TestCollective:
         collective.create_collective_group(
             members, world, list(range(world)), group_name="gbig")
 
-        for transport in ("object", "inline"):
+        try:
+            for transport in ("object", "inline"):
+                outs = ray_tpu.get(
+                    [m.do_big_allreduce.remote("gbig", n, transport)
+                     for m in members], timeout=180)
+                for first, last, shape in outs:
+                    assert first == last == 6.0  # 1+2+3
+                    assert shape == (n,)
+
             outs = ray_tpu.get(
-                [m.do_big_allreduce.remote("gbig", n, transport)
-                 for m in members], timeout=180)
-            for first, last, shape in outs:
-                assert first == last == 6.0  # 1+2+3
-                assert shape == (n,)
+                [m.do_big_broadcast.remote("gbig", n) for m in members],
+                timeout=180)
+            for second, last in outs:
+                assert second == 1.0 and last == float(n - 1)
 
-        outs = ray_tpu.get(
-            [m.do_big_broadcast.remote("gbig", n) for m in members],
-            timeout=180)
-        for second, last in outs:
-            assert second == 1.0 and last == float(n - 1)
-
-        outs = ray_tpu.get(
-            [m.do_big_allgather.remote("gbig", n) for m in members],
-            timeout=180)
-        for firsts in outs:
-            assert firsts == [0.0, 1.0, 2.0]
+            outs = ray_tpu.get(
+                [m.do_big_allgather.remote("gbig", n) for m in members],
+                timeout=180)
+            for firsts in outs:
+                assert firsts == [0.0, 1.0, 2.0]
+        finally:
+            # the shared runtime caps workers per node; leaked member +
+            # coordinator actors starve later tests of worker slots
+            for m in members:
+                ray_tpu.kill(m)
+            collective.destroy_collective_group("gbig")
 
     def test_mixed_transport_ranks_interoperate(self, rt):
         """Ranks choosing DIFFERENT transports must still rendezvous:
@@ -160,20 +167,30 @@ class TestCollective:
                    for r in range(world)]
         collective.create_collective_group(
             members, world, [0, 1], group_name="gmix")
-        outs = ray_tpu.get(
-            [members[0].do_big_allreduce.remote("gmix", 1000, "inline"),
-             members[1].do_big_allreduce.remote("gmix", 1000, "object")],
-            timeout=120)
-        for first, last, shape in outs:
-            assert first == last == 3.0 and shape == (1000,)
+        try:
+            outs = ray_tpu.get(
+                [members[0].do_big_allreduce.remote("gmix", 1000,
+                                                    "inline"),
+                 members[1].do_big_allreduce.remote("gmix", 1000,
+                                                    "object")],
+                timeout=120)
+            for first, last, shape in outs:
+                assert first == last == 3.0 and shape == (1000,)
+        finally:
+            for m in members:
+                ray_tpu.kill(m)
+            collective.destroy_collective_group("gmix")
 
     def test_invalid_transport_rejected(self, rt):
         from ray_tpu import collective
 
         collective.init_collective_group(1, 0, group_name="gsolo")
-        with pytest.raises(ValueError, match="transport"):
-            collective.allreduce(np.ones(4), group_name="gsolo",
-                                 transport="Object")
+        try:
+            with pytest.raises(ValueError, match="transport"):
+                collective.allreduce(np.ones(4), group_name="gsolo",
+                                     transport="Object")
+        finally:
+            collective.destroy_collective_group("gsolo")
 
     def test_two_member_sum(self, rt):
         from ray_tpu import collective
