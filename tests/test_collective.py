@@ -150,10 +150,16 @@ class TestCollective:
                 assert firsts == [0.0, 1.0, 2.0]
         finally:
             # the shared runtime caps workers per node; leaked member +
-            # coordinator actors starve later tests of worker slots
+            # coordinator actors starve later tests of worker slots.
+            # Per-step suppression: one dead handle must not abort the
+            # rest of the cleanup.
+            import contextlib
+
             for m in members:
-                ray_tpu.kill(m)
-            collective.destroy_collective_group("gbig")
+                with contextlib.suppress(Exception):
+                    ray_tpu.kill(m)
+            with contextlib.suppress(Exception):
+                collective.destroy_collective_group("gbig")
 
     def test_mixed_transport_ranks_interoperate(self, rt):
         """Ranks choosing DIFFERENT transports must still rendezvous:
@@ -177,9 +183,13 @@ class TestCollective:
             for first, last, shape in outs:
                 assert first == last == 3.0 and shape == (1000,)
         finally:
+            import contextlib
+
             for m in members:
-                ray_tpu.kill(m)
-            collective.destroy_collective_group("gmix")
+                with contextlib.suppress(Exception):
+                    ray_tpu.kill(m)
+            with contextlib.suppress(Exception):
+                collective.destroy_collective_group("gmix")
 
     def test_invalid_transport_rejected(self, rt):
         from ray_tpu import collective

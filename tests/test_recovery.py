@@ -280,7 +280,15 @@ def test_failed_reconstruction_fails_borrower_promptly(ray_start_cluster,
     def consume(x):
         return float(x[0])
 
-    t0 = time.monotonic()
-    with pytest.raises(Exception):
-        ray_tpu.get(consume.remote(ref), timeout=90)
-    assert time.monotonic() - t0 < 60  # failed fast, no locate hang
+    # the property under test is WHICH error surfaces: SEAL_ABORTED must
+    # fail the borrower with a lost/failed-object error, NOT a get
+    # timeout (the timeout fallback is precisely the hang this path
+    # exists to avoid). A wall-clock bound flaked under full-suite load
+    # on the 1-core CI host without distinguishing the two.
+    from ray_tpu.core.exceptions import GetTimeoutError
+
+    with pytest.raises(Exception) as excinfo:
+        ray_tpu.get(consume.remote(ref), timeout=120)
+    assert not isinstance(excinfo.value, GetTimeoutError), (
+        "borrower fell back to its get timeout instead of being failed "
+        "promptly by SEAL_ABORTED")
