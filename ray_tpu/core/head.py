@@ -730,6 +730,10 @@ class Head:
             except Exception as e:  # noqa: BLE001 — mark dead, don't die
                 with self._lock:
                     w.state = "dead"
+                    # drop the record too: persistent fork failure +
+                    # the 0.25s lease retry would otherwise grow
+                    # node.workers by a dead entry per attempt forever
+                    node.workers.pop(w.worker_id, None)
                 print(f"[ray_tpu] worker spawn failed: {e!r}",
                       file=sys.stderr)
 

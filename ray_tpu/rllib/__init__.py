@@ -16,6 +16,8 @@ from .a2c import A2C, A2CConfig, A2CLearner
 from .algorithm import Algorithm, AlgorithmConfig
 from .alpha_zero import (MCTS, AlphaZero, AlphaZeroConfig,
                          AlphaZeroLearner, TicTacToe)
+from .dreamer import (DreamerLearner, DreamerV3, DreamerV3Config,
+                      SequenceBuffer)
 from .apex_dqn import ApexDQN, ApexDQNConfig, ReplayShard
 from .ars import ARS, ARSConfig
 from .catalog import (ModelSpec, get_model, gru_forward, gru_unroll,
@@ -70,6 +72,7 @@ __all__ = [
     "init_gru", "gru_forward", "gru_unroll",
     "AlphaZero", "AlphaZeroConfig", "AlphaZeroLearner", "MCTS",
     "TicTacToe",
+    "DreamerV3", "DreamerV3Config", "DreamerLearner", "SequenceBuffer",
 ]
 
 from ray_tpu.usage_stats import record_library_usage as _rlu
