@@ -150,6 +150,28 @@ def main():
           flush=True)
     flush()
 
+    # DreamerV3 world-model + imagination update (replayed env steps
+    # consumed per second; the heaviest per-step learner in the zoo)
+    from ray_tpu.rllib.dreamer import DreamerLearner
+
+    rng = np.random.default_rng(0)
+    B, L = 16, 32
+    dreamer = DreamerLearner(obs_dim, num_actions, deter=128, hidden=128)
+    dbatches = [(rng.normal(size=(B, L, obs_dim)).astype(np.float32),
+                 rng.integers(0, num_actions, (B, L)),
+                 rng.normal(size=(B, L)).astype(np.float32),
+                 np.ones((B, L), np.float32)) for _ in range(4)]
+
+    class _DreamerShim:
+        def update(self, batch):
+            return dreamer.update(*batch)
+
+    result["dreamerv3"] = bench_learner(
+        _DreamerShim(), dbatches, B * L, args.duration)
+    print(json.dumps({"dreamerv3": result["dreamerv3"]}),
+          file=sys.stderr, flush=True)
+    flush()
+
     # ---- end-to-end (host-CPU-bound rollouts; context, not the target)
     if not args.skip_end_to_end:
         os.environ.setdefault("TPU_CHIPS", "0")
