@@ -7,7 +7,7 @@ reading the same head tables the state API uses — no aiohttp, no separate
 agent processes. Endpoints:
 
     /api/nodes /api/workers /api/actors /api/tasks /api/objects
-    /api/placement_groups   -> state API rows (JSON)
+    /api/placement_groups /api/io_loop -> state API rows (JSON)
     /api/cluster            -> resource totals/availability
     /api/jobs               -> submitted jobs (jobs.py)
     /api/metrics            -> merged metric rows (JSON)
@@ -134,6 +134,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "tasks": state.list_tasks,
                     "objects": state.list_objects,
                     "placement_groups": state.list_placement_groups,
+                    # head event-loop lag (instrumented_io_context analog)
+                    "io_loop": lambda limit=10: state.io_loop_stats(),
                 }.get(kind)
                 if fn is None:
                     self._json({"error": f"unknown endpoint {path}"}, 404)
