@@ -1,0 +1,127 @@
+"""Object-plane benchmark: single- vs multi-source striped pull throughput.
+
+Prints ONE JSON line:
+  {"metric": "object_plane_pull", "value": <multi_gbps>, "unit": "GB/s",
+   "single_source_gbps": ..., "multi_source_gbps": ..., "sources": N,
+   "payload_mb": ..., "vs_baseline": multi/single}
+
+Topology: N in-process TransferServers (one shm arena each, all holding
+the same payload) + one ObjectPuller, all on loopback TCP — the same
+code path a cross-host striped pull takes (reference: PullManager chunk
+fan-out, pull_manager.cc), minus the NIC.
+
+The headline compares single- vs multi-source with each source paced to
+a fixed per-link bandwidth (server-side chunk pacing): that is the
+regime striping exists for — cross-host pulls bottlenecked on one
+peer's link — and where the reference's PullManager fan-out wins.
+``vs_baseline`` = paced multi/single, >= 1.0 means striping aggregates
+link bandwidth with no regression. Raw (unpaced) loopback numbers are
+reported too; on a small shared host they measure memcpy/thread
+contention, not links, so they bounce around 1.0 either way.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+from ray_tpu.core import protocol as P
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import ShmObjectStore
+from ray_tpu.core.object_transfer import ObjectPuller, TransferServer
+
+PAYLOAD_MB = 64
+SOURCES = 2
+TRIALS = 5
+ARENA = (PAYLOAD_MB + 32) * 1024 * 1024
+# emulated per-source link: 5 ms per 1 MiB chunk = 200 MB/s (DCN-ish)
+LINK_PACE_S = 0.005
+
+
+def _make_source(io, payload, oid):
+    store = ShmObjectStore(f"rtpu_bop_{ObjectID.from_random().hex()[:8]}",
+                           ARENA, create=True)
+    buf = store.create(oid, len(payload))
+    buf[:] = payload
+    store.seal(oid)
+
+    def read(o, _s=store):
+        got = _s.get(o)
+        if got is None:
+            return None
+        d, m = got
+        return d, bytes(m), (lambda: _s.release(o))
+
+    return store, TransferServer(io, read, advertise_ip="127.0.0.1")
+
+
+def _timed_pull(puller, dst, oid, addrs, size):
+    dst.delete(oid)
+    t0 = time.perf_counter()
+    ok = puller.pull(oid, addrs, timeout=300, size_hint=size)
+    dt = time.perf_counter() - t0
+    if not ok:
+        print(json.dumps({"metric": "object_plane_pull", "value": 0,
+                          "unit": "GB/s", "error": "pull failed"}))
+        sys.exit(1)
+    return size / dt / 1e9
+
+
+def main():
+    io = P.IOLoop("bench-obj-io")
+    io.start()
+    payload = np.random.default_rng(0).integers(
+        0, 256, PAYLOAD_MB * 1024 * 1024, dtype=np.uint8).tobytes()
+    oid = ObjectID.from_random()
+    pairs = [_make_source(io, payload, oid) for _ in range(SOURCES)]
+    addrs = [srv.addr for _, srv in pairs]
+    dst = ShmObjectStore(f"rtpu_bop_{ObjectID.from_random().hex()[:8]}",
+                         ARENA, create=True)
+    puller = ObjectPuller(io, dst)
+    try:
+        size = len(payload)
+        _timed_pull(puller, dst, oid, addrs[:1], size)  # warm all paths
+        _timed_pull(puller, dst, oid, addrs, size)
+        # interleave single/striped trials so load drift on a shared host
+        # hits both variants equally; best-of-N is the throughput each
+        # path can sustain when the machine isn't fighting it
+        raw_single = raw_multi = 0.0
+        for _ in range(TRIALS):
+            raw_single = max(raw_single,
+                             _timed_pull(puller, dst, oid, addrs[:1], size))
+            raw_multi = max(raw_multi,
+                            _timed_pull(puller, dst, oid, addrs, size))
+        # headline: per-source link paced (the cross-host regime)
+        for _, srv in pairs:
+            srv.throttle_s = LINK_PACE_S
+        single = multi = 0.0
+        for _ in range(TRIALS):
+            single = max(single, _timed_pull(puller, dst, oid, addrs[:1],
+                                             size))
+            multi = max(multi, _timed_pull(puller, dst, oid, addrs, size))
+        assert puller.multi_source_pulls >= 1, "striping never engaged"
+        print(json.dumps({
+            "metric": "object_plane_pull",
+            "value": round(multi, 3),
+            "unit": "GB/s",
+            "single_source_gbps": round(single, 3),
+            "multi_source_gbps": round(multi, 3),
+            "raw_loopback_single_gbps": round(raw_single, 3),
+            "raw_loopback_multi_gbps": round(raw_multi, 3),
+            "link_pace_mb_s_per_source": round(1.0 / LINK_PACE_S, 1),
+            "sources": SOURCES,
+            "payload_mb": PAYLOAD_MB,
+            "vs_baseline": round(multi / single, 3) if single else 0.0,
+        }))
+    finally:
+        puller.close()
+        dst.close()
+        for store, srv in pairs:
+            srv.close()
+            store.close()
+        io.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,7 @@ from ray_tpu.core.api import (
     is_initialized,
     kill,
     nodes,
+    object_locations,
     placement_group,
     placement_group_table,
     put,
@@ -59,6 +60,7 @@ __all__ = [
     "remove_placement_group", "placement_group_table",
     "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
     "nodes", "cluster_resources", "available_resources", "timeline",
+    "object_locations",
     "RayTaskError", "ActorDiedError", "ActorUnavailableError",
     "GetTimeoutError", "ObjectLostError", "TaskCancelledError",
     "WorkerCrashedError",

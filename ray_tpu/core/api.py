@@ -571,6 +571,21 @@ def nodes() -> list:
     return get_context().node_info()
 
 
+def object_locations(ref: ObjectRef) -> dict:
+    """Holder set of a plasma-resident object from the head's object
+    directory (ref parity: ray.experimental.get_object_locations).
+    Returns {"holders": [node_idx, ...], "addrs": [transfer_addr, ...],
+    "size": int, "spilled": str}; ``holders`` and ``addrs`` are parallel
+    — ``addrs[i]`` is the transfer server serving ``holders[i]`` ('' when
+    unreachable), so head-local holders share one address."""
+    from . import protocol as P
+
+    holders, addrs, size, spilled = get_context().head.call(
+        P.OBJ_LOCATION_LOOKUP, ref.id.binary(), timeout=30)
+    return {"holders": holders, "addrs": addrs, "size": size,
+            "spilled": spilled}
+
+
 def cluster_resources() -> dict:
     total: dict = {}
     for n in nodes():

@@ -31,6 +31,12 @@ class Config:
     # Spill threshold: fraction of arena used before spilling kicks in.
     object_spilling_threshold: float = 0.8
     spill_dir: str = ""
+    # Multi-source striped pulls (reference: PullManager fans chunk
+    # requests across every node in the ObjectDirectory's holder set,
+    # pull_manager.cc): max concurrent source nodes per pull, and the
+    # minimum object size worth splitting across sources at all.
+    pull_max_sources: int = 4
+    pull_min_stripe_bytes: int = 1 * 1024 * 1024
 
     # --- scheduling ---
     # Hybrid scheduling policy: prefer local node until its utilization
@@ -49,6 +55,13 @@ class Config:
     # reference's max_pending_lease_requests_per_scheduling_category): the
     # head queues ungrantable requests, so unbounded requests just churn.
     max_pending_lease_requests_per_class: int = 10
+    # Locality-aware leasing (reference: LocalityAwareLeasePolicy +
+    # scheduler locality data, locality_aware_lease_policy.h): when a
+    # task's by-reference args total at least locality_min_arg_bytes,
+    # prefer the feasible node already holding the most argument bytes
+    # over the hybrid/spread policies — the bytes then never move.
+    scheduler_locality_enabled: bool = True
+    locality_min_arg_bytes: int = 100 * 1024
 
     # --- worker pool ---
     # Max idle workers kept alive per scheduling class.

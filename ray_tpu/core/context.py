@@ -188,6 +188,14 @@ class CoreContext:
                                self.listen_addr, node_idx, timeout=30)
         store_name = reply[0]
         self.store = ShmObjectStore(store_name)
+        # arena evictions drop this node's copy: tell the object directory
+        # so pulls stop being routed at a holder that no longer holds
+        # (reference: ObjectDirectory location removal on eviction).
+        # Async: evict() fires inside store.create on whatever thread is
+        # allocating — including the puller IO thread under its buffer
+        # lock — and a blocking socket write there would stall every
+        # in-flight transfer on this host.
+        self.store.on_evict = self._report_evictions_async
         self._stores_by_node: Dict[int, ShmObjectStore] = {node_idx: self.store}
 
         self.fn_manager = FunctionManager(self.kv_put, self.kv_get)
@@ -340,6 +348,24 @@ class CoreContext:
         self.memory_store.put_plasma_location(oid, self.node_idx)
         return ObjectRef(oid, self.worker_id)
 
+    def _report_evictions_async(self, oids: Sequence[ObjectID]):
+        """store.on_evict hook: report off-thread so the allocating thread
+        (often the puller IO thread) never blocks on a head socket write."""
+        from .object_transfer import send_eviction_report_async
+
+        if self._shutdown:
+            return
+        send_eviction_report_async(self.head, self.node_idx, oids)
+
+    def _report_evictions(self, oids: Sequence[ObjectID]):
+        """Synchronous variant — deterministic for tests that must observe
+        the directory update before their next head call."""
+        from .object_transfer import send_eviction_report
+
+        if self._shutdown:
+            return
+        send_eviction_report(self.head, self.node_idx, oids)
+
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None
             ) -> List[Any]:
         oids = [r.id for r in refs]
@@ -432,8 +458,12 @@ class CoreContext:
             f"({last_err})") from last_err
 
     def _fetch_from_plasma(self, oid: ObjectID, node_idx: int) -> Any:
-        if node_idx != self.node_idx or not self.store.contains(oid):
-            # Pull to the local node's store (reference: PullManager).
+        if not self.store.contains(oid):
+            # Pull to the local node's store (reference: PullManager). The
+            # contains() probe comes FIRST: with locality-aware placement
+            # this node is often already a holder even when the locate
+            # reply named another node as primary — a local sealed copy
+            # means zero transfer RPCs and zero bytes moved.
             self.head.call(P.OBJECT_TRANSFER, oid.binary(), self.node_idx,
                            timeout=120)
         frames = self.store.get_frames(oid)
@@ -902,10 +932,19 @@ class CoreContext:
             with self._sub_lock:
                 st.pending_leases -= 1
             return
+        # Arg-locality hint: binary ids of the sample task's by-reference
+        # args. The head scores feasible nodes by how many of those bytes
+        # they already hold (its object directory knows sizes + holder
+        # sets) and prefers the best one — the reference ships the same
+        # hint via LocalityAwareLeasePolicy on lease requests.
+        # deduped: f.remote(x, x) must not double-count x's bytes toward
+        # the locality threshold
+        arg_ids = list(dict.fromkeys(
+            enc[1] for enc in sample.args if enc[0] == ARG_REF))[:32]
         try:
             reply = self.head.call(
                 P.LEASE_REQUEST, cls, sample.resources, self.job_id.hex(),
-                dumps(sample.strategy), timeout=None)
+                dumps(sample.strategy), arg_ids, timeout=None)
             ok, worker_id, addr, lease_id, err = reply[:5]
             tpu_ids = reply[5] if len(reply) > 5 else None
         except Exception as e:  # noqa: BLE001

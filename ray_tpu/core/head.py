@@ -39,7 +39,7 @@ from .persistence import HeadStore
 from .resources import NodeResources, ResourceSet, detect_node_resources
 from .scheduler import ClusterResourceScheduler
 from .serialization import dumps, loads
-from .task_spec import PlacementGroupSpec, TaskSpec
+from .task_spec import ARG_REF, PlacementGroupSpec, TaskSpec
 
 
 @dataclass
@@ -111,10 +111,18 @@ class NodeState:
 
 @dataclass
 class _ObjLoc:
+    """Object directory entry (reference: ObjectDirectory,
+    src/ray/object_manager/object_directory.h — the full HOLDER SET per
+    object, not just the sealing node). ``node_idx`` stays the primary
+    location for the single-location paths (locate replies, spill);
+    ``holders`` is every node with a sealed copy and always contains
+    ``node_idx`` while it is >= 0."""
+
     node_idx: int = -1
     size: int = 0
     owner: str = ""
     spilled_path: str = ""
+    holders: Set[int] = field(default_factory=set)
     waiters: List[Tuple[P.Connection, int]] = field(default_factory=list)
 
 
@@ -152,6 +160,16 @@ class Head:
         # bytes relayed through head memory on the legacy path — the P2P
         # tests assert this stays 0 for host<->host transfers
         self.relay_bytes = 0
+        # locality-aware leasing counters (hit = a task landed on a node
+        # already holding its args; miss = locality applied but no holder
+        # was feasible/available and the hybrid policy decided instead)
+        self.locality_hits = 0
+        self.locality_misses = 0
+        # Worker spawner queue (drained by the spawner thread, started in
+        # start()): created here so _try_grant can enqueue spawns even on
+        # heads that are never start()ed (unit tests drive handlers
+        # directly).
+        self._spawn_q: "queue.Queue" = queue.Queue()
         # Objects that were sealed and then lost with their node (no other
         # copy, not spilled). A locate on these answers -2 immediately so
         # owners can run lineage reconstruction instead of blocking forever
@@ -212,7 +230,6 @@ class Head:
         # WorkerInfo synchronously (stampede accounting) and hands the
         # Popen to this thread. (reference: worker_pool.cc forks from
         # the raylet main loop but the raylet is not also the GCS)
-        self._spawn_q: "queue.Queue" = queue.Queue()
         self._spawner = threading.Thread(
             target=self._spawn_loop, daemon=True, name="head-spawner")
         self._spawner.start()
@@ -252,17 +269,23 @@ class Head:
         return self.tcp_addr
 
     def _read_local_object(self, oid: ObjectID):
-        """TransferServer read_fn over every in-process node store."""
+        """TransferServer read_fn over every in-process node store: any
+        local holder in the directory can serve the pull (primary first)."""
         with self._lock:
             loc = self.objects.get(oid)
-            node = self.nodes.get(loc.node_idx) if loc else None
-        if node is None or node.store is None:
-            return None
-        got = node.store.get(oid)
-        if got is None:
-            return None
-        data_v, meta_v = got
-        return data_v, bytes(meta_v), lambda: node.store.release(oid)
+            if loc is None:
+                return None
+            nodes = self._holder_nodes(loc)
+        for node in nodes:
+            if node.store is None:
+                continue
+            got = node.store.get(oid)
+            if got is None:
+                continue
+            data_v, meta_v = got
+            return (data_v, bytes(meta_v),
+                    lambda n=node: n.store.release(oid))
+        return None
 
     def _puller_for(self, node: NodeState):
         from .object_transfer import ObjectPuller
@@ -284,6 +307,13 @@ class Head:
         store_name = f"rtpu_{self.session_name}_{idx}"
         cap = object_store_memory or cfg.object_store_memory
         store = ShmObjectStore(store_name, cap, create=True)
+        # head-driven writes into this arena (relay _node_store_write,
+        # _puller_for pulls) can evict LRU objects: keep the object
+        # directory honest for those too. Workers attached to the same
+        # arena report their own evictions via context's hook. _lock is
+        # an RLock, so firing inside a locked head path is safe.
+        store.on_evict = lambda oids, _i=idx: self._on_local_evictions(
+            _i, oids)
         nr = detect_node_resources(num_cpus=num_cpus, num_tpus=num_tpus,
                                    memory=memory,
                                    object_store_memory=cap,
@@ -376,25 +406,27 @@ class Head:
         if kill_workers:
             for w in list(node.workers.values()):
                 self._kill_worker_process(w)
-        # objects on this node are lost: answer any blocked locates with the
-        # LOST sentinel (-2) and remember the ids so later locates fail fast
-        # — owners react by re-executing the creating task (lineage
-        # reconstruction; reference: object_recovery_manager.h:41)
+        # objects whose ONLY copy lived on this node are lost: answer any
+        # blocked locates with the LOST sentinel (-2) and remember the ids
+        # so later locates fail fast — owners react by re-executing the
+        # creating task (lineage reconstruction; reference:
+        # object_recovery_manager.h:41). Objects with surviving replicas
+        # in the directory just fail over to another holder.
         lost_waiters: List[Tuple[P.Connection, int]] = []
         with self._lock:
-            lost = [oid for oid, loc in self.objects.items()
-                    if loc.node_idx == idx and not loc.spilled_path]
-            for oid in lost:
-                lost_waiters.extend(self.objects[oid].waiters)
-                del self.objects[oid]
-                self.lost_objects[oid] = None
-            while len(self.lost_objects) > 65536:
-                self.lost_objects.pop(next(iter(self.lost_objects)))
-        for wconn, wrid in lost_waiters:
-            try:
-                wconn.reply(wrid, -2, 0, "", msg_type=P.OBJECT_LOCATE_REPLY)
-            except P.ConnectionLost:
-                pass
+            lost = []
+            for oid, loc in list(self.objects.items()):
+                loc.holders.discard(idx)
+                if loc.node_idx != idx:
+                    continue
+                if loc.holders:
+                    loc.node_idx = min(loc.holders)  # promote a replica
+                elif loc.spilled_path:
+                    loc.node_idx = -1
+                else:
+                    lost.append(oid)
+            lost_waiters = self._mark_objects_lost(lost)
+        self._reply_lost(lost_waiters)
         if node.store is not None:
             node.store.close()
         if node.agent_conn is not None:
@@ -510,17 +542,20 @@ class Head:
     # ----------------------------------------------------------- leases
 
     def _h_lease_request(self, conn, rid, sched_class, resources, job_id_hex,
-                         strategy_bytes):
+                         strategy_bytes, arg_ids=None):
+        """``arg_ids`` — binary ObjectIDs of the sample task's by-reference
+        args (the reference ships the same hint with lease requests so the
+        raylet can score locality, LocalityAwareLeasePolicy)."""
         self._queue_lease(conn, rid, sched_class, resources, job_id_hex,
-                          strategy_bytes)
+                          strategy_bytes, arg_ids)
         self._try_fulfill_pending()
 
     def _queue_lease(self, conn, rid, sched_class, resources, job_id_hex,
-                     strategy_bytes):
+                     strategy_bytes, arg_ids=None):
         with self._lock:
             self._pending_leases.append(
                 (conn, rid, tuple(sched_class), ResourceSet(resources),
-                 job_id_hex, strategy_bytes))
+                 job_id_hex, strategy_bytes, arg_ids))
 
     def _try_fulfill_pending(self):
         """Dispatch loop: try to grant queued leases (reference:
@@ -535,10 +570,12 @@ class Head:
             for item in pending:
                 demand[item[2]] = demand.get(item[2], 0) + 1
             for item in pending:
-                conn, rid, sched_class, request, job_hex, strategy_bytes = item
+                (conn, rid, sched_class, request, job_hex, strategy_bytes,
+                 arg_ids) = item
                 strategy: SchedulingStrategy = loads(strategy_bytes)
                 grant = self._try_grant(sched_class, request, strategy,
-                                        demand=demand.get(sched_class, 1))
+                                        demand=demand.get(sched_class, 1),
+                                        arg_ids=arg_ids)
                 if grant is None:
                     continue
                 with self._lock:
@@ -565,7 +602,8 @@ class Head:
                 return
 
     def _try_grant(self, sched_class, request: ResourceSet, strategy,
-                   demand: int = 1) -> Optional[Tuple[object, str]]:
+                   demand: int = 1, arg_ids=None
+                   ) -> Optional[Tuple[object, str]]:
         """Try to allocate resources + a worker. Returns (WorkerInfo, lease)
         or ("spawning", "") if a worker is being started, or None.
 
@@ -574,8 +612,17 @@ class Head:
         (the round-1 bug was the actor-creation retry timer forking a fresh
         interpreter every 50ms, starving the CPU so *no* worker ever finished
         importing; ref: WorkerPool pending-registration accounting,
-        src/ray/raylet/worker_pool.cc)."""
+        src/ray/raylet/worker_pool.cc).
+
+        ``arg_ids`` (binary ObjectIDs of the sample task's by-ref args)
+        turns on locality-aware placement: when those args' directory
+        sizes total at least ``locality_min_arg_bytes``, the node already
+        holding the most argument bytes is preferred over the hybrid
+        policy — the bytes then never move at all (reference:
+        LocalityAwareLeasePolicy over the object directory)."""
+        cfg = get_config()
         with self._lock:
+            loc_choice = None
             pg_id = strategy.placement_group_id
             if pg_id is not None:
                 node_idx = self._pg_node_for(pg_id, strategy.bundle_index,
@@ -583,7 +630,22 @@ class Head:
                 if node_idx is None:
                     return None
             else:
-                node_idx = self.scheduler.best_node(request, strategy)
+                node_idx = None
+                # hit/miss is counted only when the lease is actually
+                # granted (below) — a queued lease re-runs this branch on
+                # every dispatch retry while its worker spawns, and
+                # counting attempts would inflate the placement counters
+                # the object_plane endpoint reports by the retry rate
+                if (arg_ids and cfg.scheduler_locality_enabled
+                        and strategy.kind == "DEFAULT"):
+                    scores, total = self._locality_scores(arg_ids)
+                    if total >= cfg.locality_min_arg_bytes:
+                        node_idx = self.scheduler.best_locality_node(
+                            request, scores)
+                        loc_choice = "hit" if node_idx is not None \
+                            else "miss"
+                if node_idx is None:
+                    node_idx = self.scheduler.best_node(request, strategy)
                 if node_idx is None:
                     return None
             node = self.nodes[node_idx]
@@ -609,6 +671,7 @@ class Head:
                 w.lease_id = lease_id
                 self.leases[lease_id] = (node_idx, request, wid,
                                          pg_binding, tpu_ids)
+                self._count_locality(loc_choice)
                 return w, lease_id
             # reuse any idle worker (repurpose across scheduling classes)
             for cls, lst in node.idle_by_class.items():
@@ -620,6 +683,7 @@ class Head:
                     w.lease_id = lease_id
                     self.leases[lease_id] = (node_idx, request, wid,
                                              pg_binding, tpu_ids)
+                    self._count_locality(loc_choice)
                     return w, lease_id
             # spawn a new worker (unless enough are already starting),
             # re-queue the lease until it registers. The gate is bounded
@@ -655,6 +719,14 @@ class Head:
             self._release_tpu_chips(node, tpu_ids)
             del self.leases[lease_id]
             return None
+
+    def _count_locality(self, loc_choice: Optional[str]):
+        """Locality placement counters, bumped only on a completed grant
+        (caller holds the lock)."""
+        if loc_choice == "hit":
+            self.locality_hits += 1
+        elif loc_choice == "miss":
+            self.locality_misses += 1
 
     def _allocate_tpu_chips(self, node: NodeState, request: ResourceSet):
         """Assign specific chip indices for a TPU lease — the reference's
@@ -858,12 +930,17 @@ class Head:
         spec = info.spec
         request = ResourceSet(spec.resources)
         deadline = time.monotonic() + get_config().actor_creation_timeout_s
+        # actors benefit from arg locality too: a big by-ref constructor
+        # arg (e.g. sharded weights) anchors the actor next to the bytes
+        # (same dedup + 32-arg hint cap as the task lease path)
+        arg_ids = list(dict.fromkeys(
+            enc[1] for enc in spec.args if enc[0] == ARG_REF))[:32]
 
         def attempt():
             if self._shutdown:
                 return
             grant = self._try_grant(spec.scheduling_class(), request,
-                                    spec.strategy)
+                                    spec.strategy, arg_ids=arg_ids)
             if grant is None:
                 if time.monotonic() > deadline:
                     self._mark_actor_dead(info, "creation timed out (no "
@@ -1239,6 +1316,7 @@ class Head:
             loc.node_idx = node_idx
             loc.size = size
             loc.owner = owner
+            loc.holders.add(node_idx)
             waiters = list(loc.waiters)
             loc.waiters.clear()
         for wconn, wrid in waiters:
@@ -1248,6 +1326,140 @@ class Head:
             except P.ConnectionLost:
                 pass  # that waiter died; the rest must still hear
         self._maybe_spill(node_idx)
+
+    def _directory_add(self, oid: ObjectID, node_idx: int, size: int = 0):
+        """A node gained a copy (pull completion / replica creation)."""
+        waiters: List[Tuple[P.Connection, int]] = []
+        with self._lock:
+            self.lost_objects.pop(oid, None)
+            loc = self.objects.setdefault(oid, _ObjLoc())
+            loc.holders.add(node_idx)
+            if size > 0 and loc.size <= 0:
+                loc.size = size
+            if loc.node_idx < 0:
+                loc.node_idx = node_idx
+            if loc.waiters:
+                waiters = list(loc.waiters)
+                loc.waiters.clear()
+            node_idx, size = loc.node_idx, loc.size
+        for wconn, wrid in waiters:
+            try:
+                wconn.reply(wrid, node_idx, size, "",
+                            msg_type=P.OBJECT_LOCATE_REPLY)
+            except P.ConnectionLost:
+                pass
+
+    def _h_obj_location_add(self, conn, rid, oid_bin, node_idx, size=0):
+        self._directory_add(ObjectID(oid_bin), node_idx, size)
+        if rid > 0:
+            conn.reply(rid, True)
+
+    def _on_local_evictions(self, node_idx: int, oids):
+        """on_evict hook for head-local arenas: same directory upkeep as
+        an agent's OBJ_LOCATION_REMOVE report, minus the network hop. The
+        bookkeeping is in-memory under the head RLock — safe from any
+        locked head path, including the head puller's IO thread — but the
+        LOST-waiter replies are blocking socket writes, so they go to a
+        side thread rather than stalling whatever triggered the eviction."""
+        waiters = self._directory_remove(
+            [oid.binary() for oid in oids], node_idx)
+        if waiters:
+            threading.Thread(target=self._reply_lost, args=(waiters,),
+                             daemon=True).start()
+
+    def _h_obj_location_remove(self, conn, rid, oid_bins, node_idx):
+        """A node dropped copies (arena eviction / local deletion) — one
+        batched message per eviction sweep."""
+        self._reply_lost(self._directory_remove(oid_bins, node_idx))
+        if rid > 0:
+            conn.reply(rid, True)
+
+    def _directory_remove(self, oid_bins, node_idx: int
+                          ) -> List[Tuple[P.Connection, int]]:
+        """Holder-set removal bookkeeping; returns the blocked-locate
+        waiters that must hear the LOST sentinel (reply via _reply_lost
+        off the caller's critical path)."""
+        with self._lock:
+            lost = []
+            for ob in oid_bins:
+                oid = ObjectID(ob)
+                loc = self.objects.get(oid)
+                # Only act when the node is a recorded holder: an eviction
+                # report racing ahead of the sealing worker's OBJECT_SEALED
+                # (different head connections — cross-connection order is
+                # not guaranteed) must not declare a never-sealed waiter
+                # entry LOST. The inverse race (remove lands before the
+                # entry even exists, leaving a stale holder once SEALED
+                # arrives) is benign: pulls fail over off stale entries
+                # per-object.
+                if loc is None or node_idx not in loc.holders:
+                    continue
+                loc.holders.discard(node_idx)
+                if loc.node_idx == node_idx:
+                    loc.node_idx = min(loc.holders) if loc.holders else -1
+                if loc.node_idx < 0 and not loc.spilled_path:
+                    # last copy evicted and nothing on disk: the object is
+                    # LOST — same outcome as its node dying
+                    lost.append(oid)
+            return self._mark_objects_lost(lost)
+
+    def _mark_objects_lost(self, oids
+                           ) -> List[Tuple[P.Connection, int]]:
+        """Drop directory entries whose final copy is gone and remember
+        the ids as LOST (bounded set) so later locates fail fast — owners
+        react by re-executing the creating task (lineage reconstruction;
+        reference: object_recovery_manager.h:41). Caller holds the lock;
+        pass the returned blocked-locate waiters to ``_reply_lost`` AFTER
+        releasing it."""
+        waiters: List[Tuple[P.Connection, int]] = []
+        for oid in oids:
+            loc = self.objects.pop(oid, None)
+            if loc is not None:
+                waiters.extend(loc.waiters)
+                loc.waiters.clear()
+            self.lost_objects[oid] = None
+        while len(self.lost_objects) > 65536:
+            self.lost_objects.pop(next(iter(self.lost_objects)))
+        return waiters
+
+    def _reply_lost(self, waiters):
+        """Answer blocked locates with the LOST sentinel (-2)."""
+        for wconn, wrid in waiters:
+            try:
+                wconn.reply(wrid, -2, 0, "", msg_type=P.OBJECT_LOCATE_REPLY)
+            except P.ConnectionLost:
+                pass
+
+    def _h_obj_location_lookup(self, conn, rid, oid_bin):
+        """Full holder-set query: ([holder_idxs], [transfer_addrs], size,
+        spilled_url). The lists are PARALLEL — addrs[i] serves holders[i]
+        ('' when that holder has no reachable transfer server), so two
+        head-local holders both report the head's one TransferServer
+        address. A puller dedupes before striping."""
+        with self._lock:
+            loc = self.objects.get(ObjectID(oid_bin))
+            if loc is None:
+                conn.reply(rid, [], [], 0, "")
+                return
+            nodes = sorted(self._holder_nodes(loc), key=lambda n: n.idx)
+            holders = [n.idx for n in nodes]
+            addrs = [self._node_transfer_addr(n) for n in nodes]
+            size, spilled = loc.size, loc.spilled_path
+        conn.reply(rid, holders, addrs, size, spilled)
+
+    def _locality_scores(self, arg_ids) -> Tuple[Dict[int, int], int]:
+        """Per-node bytes of the given args already resident there, plus
+        the args' total size. Caller holds the lock."""
+        scores: Dict[int, int] = {}
+        total = 0
+        for ob in dict.fromkeys(arg_ids):  # a dup arg counts its bytes once
+            loc = self.objects.get(ObjectID(ob))
+            if loc is None or loc.size <= 0:
+                continue
+            total += loc.size
+            for h in loc.holders:
+                scores[h] = scores.get(h, 0) + loc.size
+        return scores, total
 
     def _h_object_locate(self, conn, rid, oid_bin, block):
         oid = ObjectID(oid_bin)
@@ -1273,24 +1485,17 @@ class Head:
         seal. Mark them LOST and answer blocked locates with -2 so
         borrowers surface ObjectLostError instead of hanging (the owner
         holds the actual error in its in-process store)."""
-        waiters: List[Tuple[P.Connection, int]] = []
         with self._lock:
+            lost = []
             for ob in oid_bins:
                 oid = ObjectID(ob)
                 loc = self.objects.get(oid)
                 if loc is not None and (loc.node_idx >= 0 or
                                         loc.spilled_path):
                     continue  # a real copy exists (e.g. partial returns)
-                if loc is not None:
-                    waiters.extend(loc.waiters)
-                    loc.waiters.clear()
-                    del self.objects[oid]
-                self.lost_objects[oid] = None
-        for wconn, wrid in waiters:
-            try:
-                wconn.reply(wrid, -2, 0, "", msg_type=P.OBJECT_LOCATE_REPLY)
-            except P.ConnectionLost:
-                pass
+                lost.append(oid)
+            waiters = self._mark_objects_lost(lost)
+        self._reply_lost(waiters)
 
     def _h_object_recovering(self, conn, rid, oid_bins):
         """An owner is re-executing the creating task for these lost
@@ -1315,8 +1520,14 @@ class Head:
                     os.unlink(loc.spilled_path)
                 except OSError:
                     pass
-            node = self.nodes.get(loc.node_idx)
-            if node is not None and node.alive:
+            # every holder in the directory drops its copy
+            targets = set(loc.holders)
+            if loc.node_idx >= 0:
+                targets.add(loc.node_idx)
+            for idx in targets:
+                node = self.nodes.get(idx)
+                if node is None or not node.alive:
+                    continue
                 if node.store is not None:
                     node.store.delete(oid)
                 elif node.agent_conn is not None:
@@ -1369,23 +1580,59 @@ class Head:
             node.agent_conn.call(P.AGENT_OBJ_PUT, oid.binary(), payload,
                                  meta, timeout=120)
 
-    def _p2p_transfer(self, oid: ObjectID, src_node: NodeState,
+    def _holder_nodes(self, loc: _ObjLoc, exclude_idx: int = -1
+                      ) -> List[NodeState]:
+        """Live holder nodes, primary first — THE directory traversal
+        every read/transfer path shares (caller holds the lock)."""
+        out: List[NodeState] = []
+        for idx in dict.fromkeys([loc.node_idx] + sorted(loc.holders)):
+            if idx < 0 or idx == exclude_idx:
+                continue
+            node = self.nodes.get(idx)
+            if node is None or not node.alive:
+                continue
+            out.append(node)
+        return out
+
+    def _node_transfer_addr(self, node: NodeState) -> str:
+        """The transfer address serving a node's objects — every
+        head-local holder is served by the head host's one TransferServer."""
+        if node.is_remote:
+            return node.transfer_addr or ""
+        return self._transfer_server.addr if self._transfer_server else ""
+
+    def _holder_addrs(self, loc: _ObjLoc, exclude_idx: int = -1
+                      ) -> List[str]:
+        """Transfer addresses of every live holder, primary first — the
+        source list a striped pull fans out across (reference: the
+        ObjectDirectory's location set handed to the PullManager)."""
+        with self._lock:
+            addrs = [self._node_transfer_addr(n)
+                     for n in self._holder_nodes(loc, exclude_idx)]
+        return list(dict.fromkeys(a for a in addrs if a))
+
+    def _p2p_transfer(self, oid: ObjectID, loc: _ObjLoc,
                       dst_node: NodeState) -> bool:
-        """Direct host-to-host pull; returns False to fall back to relay."""
-        src_addr = (src_node.transfer_addr if src_node.is_remote
-                    else (self._transfer_server.addr
-                          if self._transfer_server else ""))
-        if not src_addr:
+        """Direct host-to-host pull, striped across every live holder;
+        returns False to fall back to relay."""
+        addrs = self._holder_addrs(loc, exclude_idx=dst_node.idx)
+        if not addrs:
             return False
         try:
             if dst_node.is_remote:
-                # dst agent pulls straight from the src host
+                # dst agent pulls straight from the holder hosts
                 reply = dst_node.agent_conn.call(
-                    P.PULL_OBJECT, oid.binary(), src_addr, timeout=120)
-                return bool(reply[0])
-            # dst is a head-local node: the head IS the destination host —
-            # pull from the src agent directly into the local arena.
-            return bool(self._puller_for(dst_node).pull(oid, src_addr))
+                    P.PULL_OBJECT, oid.binary(), addrs, loc.size,
+                    timeout=120)
+                ok = bool(reply[0])
+            else:
+                # dst is a head-local node: the head IS the destination
+                # host — pull straight into the local arena.
+                ok = bool(self._puller_for(dst_node).pull(
+                    oid, addrs, size_hint=loc.size))
+            if ok:
+                self._directory_add(oid, dst_node.idx)
+            return ok
         except (P.ConnectionLost, TimeoutError):
             return False
 
@@ -1401,13 +1648,14 @@ class Head:
         oid = ObjectID(oid_bin)
         with self._lock:
             loc = self.objects.get(oid)
+            any_remote_holder = loc is not None and any(
+                self.nodes[h].is_remote for h in loc.holders
+                if h in self.nodes)
         if loc is None:
             conn.reply_error(rid, KeyError(f"object {oid.hex()} unknown"))
             return
         dst_node = self.nodes[to_node_idx]
-        src_node = self.nodes.get(loc.node_idx)
-        if dst_node.is_remote or (src_node is not None
-                                  and src_node.is_remote):
+        if dst_node.is_remote or any_remote_holder:
             threading.Thread(
                 target=self._do_object_transfer,
                 args=(conn, rid, oid, loc, dst_node), daemon=True).start()
@@ -1419,13 +1667,17 @@ class Head:
             if self._node_store_contains(dst_node, oid):
                 conn.reply(rid, True)
                 return
-            src_node = self.nodes.get(loc.node_idx)
-            if not loc.spilled_path and src_node is not None and \
-                    (src_node.is_remote or dst_node.is_remote):
+            with self._lock:
+                any_remote_holder = any(
+                    self.nodes[h].is_remote for h in loc.holders
+                    if h in self.nodes)
+            if not loc.spilled_path and (dst_node.is_remote
+                                         or any_remote_holder):
                 # Peer-to-peer path: the head only brokers the pull — the
-                # payload rides a direct host<->host connection (reference:
+                # payload rides direct host<->host connections, striped
+                # across the directory's holder set (reference:
                 # ObjectManager chunked pull, never through the GCS).
-                if self._p2p_transfer(oid, src_node, dst_node):
+                if self._p2p_transfer(oid, loc, dst_node):
                     conn.reply(rid, True)
                     return
                 # fall through to the relay path on any P2P failure
@@ -1437,13 +1689,23 @@ class Head:
                 meta = data[8:8 + meta_len]
                 payload = data[8 + meta_len:]
             else:
-                got = self._node_store_read(self.nodes[loc.node_idx], oid)
+                # relay read from any live holder (primary first)
+                with self._lock:
+                    cand = self._holder_nodes(loc)
+                got = None
+                for node in cand:
+                    # a holder entry can be stale (eviction report lost):
+                    # keep trying the remaining holders before giving up
+                    got = self._node_store_read(node, oid)
+                    if got is not None:
+                        break
                 if got is None:
                     conn.reply_error(
                         rid, KeyError(f"object {oid.hex()} gone"))
                     return
                 payload, meta = got
             self._node_store_write(dst_node, oid, payload, meta)
+            self._directory_add(oid, dst_node.idx)
             conn.reply(rid, True)
         except P.ConnectionLost:
             pass
@@ -1493,7 +1755,10 @@ class Head:
                 store.release(oid)
             with self._lock:
                 loc.spilled_path = path
-                loc.node_idx = -1
+                loc.holders.discard(node_idx)
+                # another node may still hold a live replica; only fall
+                # back to the spill file when no arena copy remains
+                loc.node_idx = min(loc.holders) if loc.holders else -1
             store.delete(oid)
 
     # ------------------------------------------------------------ cluster info
@@ -1573,8 +1838,25 @@ class Head:
                     "object_id": oid.hex(), "node_idx": loc.node_idx,
                     "size": loc.size, "owner": loc.owner,
                     "spilled": bool(loc.spilled_path),
+                    "holders": sorted(loc.holders),
                 } for oid, loc in self.objects.items()
                     if loc.node_idx >= 0 or loc.spilled_path]
+            elif kind == "object_plane":
+                # object data-plane snapshot: directory shape + locality
+                # placement counters (pull-side counters arrive via the
+                # normal METRICS_REPORT path and land under "metrics")
+                live = [loc for loc in self.objects.values()
+                        if loc.node_idx >= 0 or loc.spilled_path]
+                rows = [{
+                    "directory_objects": len(live),
+                    "directory_bytes": sum(l.size for l in live),
+                    "replicated_objects": sum(
+                        1 for l in live if len(l.holders) > 1),
+                    "holder_entries": sum(len(l.holders) for l in live),
+                    "locality_hits": self.locality_hits,
+                    "locality_misses": self.locality_misses,
+                    "relay_bytes": self.relay_bytes,
+                }]
             elif kind == "metrics":
                 rows = list(self.metrics.values())
             elif kind == "io_loop":
@@ -1758,6 +2040,9 @@ class Head:
         P.OBJECT_SEALED: _h_object_sealed,
         P.OBJECT_LOCATE: _h_object_locate,
         P.OBJECT_FREE: _h_object_free,
+        P.OBJ_LOCATION_ADD: _h_obj_location_add,
+        P.OBJ_LOCATION_REMOVE: _h_obj_location_remove,
+        P.OBJ_LOCATION_LOOKUP: _h_obj_location_lookup,
         P.OBJECT_RECOVERING: _h_object_recovering,
         P.OBJECT_TRANSFER: _h_object_transfer,
         P.NODE_INFO: _h_node_info,

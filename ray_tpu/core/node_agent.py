@@ -53,6 +53,12 @@ class NodeAgent:
         cap = object_store_memory or cfg.object_store_memory
         self.store_name = f"rtpu_agent_{uuid.uuid4().hex[:10]}"
         self.store = ShmObjectStore(self.store_name, cap, create=True)
+        # agent-side arena evictions (pull/relay writes squeezing out LRU
+        # objects) drop copies the head's object directory still lists —
+        # report them so pulls stop targeting this host for those ids.
+        # Async: evict() fires inside store.create on the allocating
+        # thread (the puller IO thread included) and must not block there.
+        self.store.on_evict = self._report_evictions_async
         self.session_dir = f"/tmp/ray_tpu/agent_{uuid.uuid4().hex[:8]}"
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         self.workers: Dict[str, subprocess.Popen] = {}
@@ -151,10 +157,14 @@ class NodeAgent:
                     self.store.seal(oid)
                 conn.reply(rid, True)
             elif mt == P.PULL_OBJECT:
-                # head says: fetch this object straight from a peer host
-                oid, peer = ObjectID(msg[2]), msg[3]
+                # head says: fetch this object straight from peer hosts —
+                # msg carries the directory's holder-address list (or one
+                # addr string) plus the object size for stripe planning
+                oid, peers = ObjectID(msg[2]), msg[3]
+                size = msg[4] if len(msg) > 4 else -1
                 threading.Thread(
-                    target=self._do_pull, args=(conn, rid, oid, peer),
+                    target=self._do_pull,
+                    args=(conn, rid, oid, peers, size),
                     daemon=True).start()
             elif mt == P.AGENT_OBJ_FREE:
                 for ob in msg[2]:
@@ -166,9 +176,18 @@ class NodeAgent:
                 conn.reply_error(rid, e)
 
     def _do_pull(self, conn: P.Connection, rid: int, oid: ObjectID,
-                 peer: str):
+                 peers, size: int = -1):
         try:
-            ok = self.puller.pull(oid, peer)
+            ok = self.puller.pull(oid, peers, size_hint=size)
+            if ok and self.node_idx is not None:
+                # report the gained copy so the directory lists this node
+                # as a holder independent of the broker path's bookkeeping
+                # (idempotent with the head's own _directory_add)
+                try:
+                    self.head.send(P.OBJ_LOCATION_ADD, oid.binary(),
+                                   self.node_idx, max(size, 0))
+                except P.ConnectionLost:
+                    pass
             conn.reply(rid, ok)
         except Exception as e:  # noqa: BLE001
             if rid > 0:
@@ -176,6 +195,15 @@ class NodeAgent:
                     conn.reply_error(rid, e)
                 except P.ConnectionLost:
                     pass
+
+    def _report_evictions_async(self, oids):
+        """store.on_evict hook: report off-thread so the allocating thread
+        never blocks on a head socket write."""
+        from .object_transfer import send_eviction_report_async
+
+        if self.node_idx is None or self._shutdown.is_set():
+            return
+        send_eviction_report_async(self.head, self.node_idx, oids)
 
     # ------------------------------------------------------------- workers
 

@@ -94,6 +94,11 @@ class ShmObjectStore:
             raise RuntimeError(
                 f"Failed to {'create' if create else 'attach'} shm store {name}")
         self._creator = create
+        # Eviction hook: called with the evicted ObjectIDs so the process
+        # can report lost copies to the head's object directory
+        # (OBJ_LOCATION_REMOVE) — a stale directory entry would otherwise
+        # only be discovered by a pull failing over off it.
+        self.on_evict: Optional[callable] = None
         # Map the segment for data access (metadata is managed by the C side).
         fd = os.open(f"/dev/shm/{name}", os.O_RDWR)
         try:
@@ -199,9 +204,15 @@ class ShmObjectStore:
             return []
         buf = ctypes.create_string_buffer(_ID_SIZE * 256)
         n = get_lib().shm_store_evict(self._h, need, buf, 256)
-        return [
+        evicted = [
             ObjectID(buf.raw[i * _ID_SIZE:(i + 1) * _ID_SIZE]) for i in range(n)
         ]
+        if evicted and self.on_evict is not None:
+            try:
+                self.on_evict(evicted)
+            except Exception:  # noqa: BLE001 — directory upkeep must never
+                pass           # fail the allocation that triggered eviction
+        return evicted
 
     def bytes_in_use(self) -> int:
         if self._closed:

@@ -8,15 +8,24 @@ behalf of its in-process nodes) runs a ``TransferServer`` — a dedicated
 TCP listener streaming objects out of the local shm arena in ~1 MiB raw
 frames — and an ``ObjectPuller`` that connects straight to a peer's
 server and writes arriving chunks into the local arena. The head only
-brokers *who pulls from whom* (it hands the destination the source's
-transfer address); payload bytes never touch head memory (asserted by
+brokers *who pulls from whom* (it hands the destination the holder set's
+transfer addresses); payload bytes never touch head memory (asserted by
 tests via the head's relay-byte counter).
 
+Multi-source striped pulls (the reference's PullManager fan-out): when
+the directory reports several holders and the object is large, the
+puller opens connections to up to ``pull_max_sources`` of them and
+requests disjoint contiguous ranges from each. Every chunk header
+carries its absolute offset, so writes route into one arena buffer
+regardless of which source they rode in on. A source dying mid-pull
+fails only its remaining range: the tail it never delivered is
+re-requested from a surviving holder instead of failing the pull.
+
 Wire flow (all frames on a direct peer<->peer connection):
-    puller -> server   OBJ_PULL (oid)                       one-way
-    server -> puller   OBJ_PULL_META (oid, size|-1, meta)   create buffer
-    server -> puller   OBJ_PULL_CHUNK hdr + RAW frame  x N  (atomic pair)
-    server -> puller   OBJ_PULL_DONE (oid)                  seal + wake
+    puller -> server   OBJ_PULL (oid, start, length)         one-way
+    server -> puller   OBJ_PULL_META (oid, size|-1, meta)    create buffer
+    server -> puller   OBJ_PULL_CHUNK hdr + RAW frame  x N   (atomic pair)
+    server -> puller   OBJ_PULL_DONE (oid, start, length)    range complete
 
 Every buffer mutation happens on the puller's single IO thread, in stream
 order — META creates the arena buffer before any chunk of that object can
@@ -26,7 +35,10 @@ be dispatched, so there is no allocation/arrival race by construction.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, Optional, Tuple
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
 
 from . import protocol as P
 from .config import get_config
@@ -35,7 +47,7 @@ from .object_store import ObjectExistsError, ShmObjectStore
 
 
 class TransferServer:
-    """Serves OBJ_PULL requests for objects in local shm arenas.
+    """Serves OBJ_PULL range requests for objects in local shm arenas.
 
     ``read_fn(oid) -> (data_memoryview, meta_bytes, release_cb) | None``
     abstracts over "one agent store" vs "the head's local node stores".
@@ -49,6 +61,9 @@ class TransferServer:
         ip = advertise_ip or P.local_ip()
         self.addr = f"tcp:{ip}:{port}"
         self._io = io
+        # per-chunk pause, settable by tests/chaos tooling to exercise the
+        # mid-pull source-failure path deterministically
+        self.throttle_s = 0.0
         io.add_listener(self._listener, self._on_accept)
 
     def _on_accept(self, sock, _addr):
@@ -59,15 +74,19 @@ class TransferServer:
     def _on_message(self, conn: P.Connection, msg):
         if msg[0] != P.OBJ_PULL:
             return
+        start = msg[3] if len(msg) > 3 else 0
+        length = msg[4] if len(msg) > 4 else -1
         # Stream on a side thread: a multi-GiB send must not wedge the IO
         # loop that every other connection on this host shares. Concurrent
         # pulls on one connection are safe: each chunk's header+raw pair is
         # sent atomically (send_with_raw), and the puller writes by the
         # (oid, offset) in each header.
-        threading.Thread(target=self._serve_pull, args=(conn, msg[2]),
+        threading.Thread(target=self._serve_pull,
+                         args=(conn, msg[2], start, length),
                          daemon=True).start()
 
-    def _serve_pull(self, conn: P.Connection, oid_bin: bytes):
+    def _serve_pull(self, conn: P.Connection, oid_bin: bytes,
+                    start: int = 0, length: int = -1):
         oid = ObjectID(oid_bin)
         got = self._read_fn(oid)
         try:
@@ -76,17 +95,24 @@ class TransferServer:
                 return
             data, meta, release = got
             try:
+                # META always reports the FULL object size + meta so any
+                # one source's reply lets the puller size the arena buffer
                 conn.send(P.OBJ_PULL_META, oid_bin, len(data), bytes(meta))
+                end = len(data) if length < 0 else min(start + length,
+                                                       len(data))
                 # ~1 MiB chunks so each typically completes within one
                 # receiver recv() buffer, hitting feed()'s zero-copy fast
                 # path (protocol.py). Each chunk is written straight from
                 # the shm arena view — no serialization copies.
                 cs = min(get_config().object_transfer_chunk_bytes, 1 << 20)
-                for off in range(0, len(data), cs):
-                    end = min(off + cs, len(data))
+                for off in range(start, end, cs):
+                    if self.throttle_s:
+                        time.sleep(self.throttle_s)
                     conn.send_with_raw(P.OBJ_PULL_CHUNK, oid_bin, off,
-                                       raw=data[off:end])
-                conn.send(P.OBJ_PULL_DONE, oid_bin)
+                                       raw=data[off:min(off + cs, end)])
+                # echo the REQUESTED range so the puller can match it even
+                # when length was -1 (open-ended)
+                conn.send(P.OBJ_PULL_DONE, oid_bin, start, length)
             finally:
                 release()
         except P.ConnectionLost:
@@ -100,22 +126,71 @@ class TransferServer:
             pass
 
 
-class _PullState:
-    __slots__ = ("buf", "done", "error", "conn", "buf_lock")
+def send_eviction_report(head_conn, node_idx: int, oids) -> None:
+    """One batched one-way OBJ_LOCATION_REMOVE dropping ``node_idx`` from
+    the evicted objects' holder sets (best-effort: a missed report just
+    means one extra pull failover off a stale directory entry)."""
+    oid_bins = [oid.binary() for oid in oids]
+    if not oid_bins:
+        return
+    try:
+        head_conn.send(P.OBJ_LOCATION_REMOVE, oid_bins, node_idx)
+    except P.ConnectionLost:
+        pass
 
-    def __init__(self, conn: P.Connection):
+
+def send_eviction_report_async(head_conn, node_idx: int, oids) -> None:
+    """Same, from a short-lived thread: evict() fires inside store.create
+    on whatever thread is allocating — the puller IO thread included —
+    and must never block there on a head socket write."""
+    oids = list(oids)
+    threading.Thread(target=send_eviction_report,
+                     args=(head_conn, node_idx, oids), daemon=True).start()
+
+
+class _Range:
+    """One contiguous byte range assigned to one source."""
+
+    __slots__ = ("start", "length", "received", "addr", "done")
+
+    def __init__(self, start: int, length: int, addr: str):
+        self.start = start
+        self.length = length  # -1 = through end (size unknown at request)
+        self.received = 0     # chunks per range arrive in order
+        self.addr = addr
+        self.done = False
+
+
+class _PullState:
+    __slots__ = ("buf", "done", "error", "buf_lock", "size", "ranges",
+                 "conns", "addrs", "failed_addrs", "started",
+                 "planned_sources")
+
+    def __init__(self):
         self.buf = None
         self.done = threading.Event()
         self.error: Optional[str] = None
-        self.conn = conn
-        # serializes chunk writes against the abort path's buf=None +
-        # arena delete — a copy into a freed (and possibly reallocated)
-        # arena slot would corrupt another object
+        self.size = -1  # full object size, set by the first META
+        self.planned_sources = 0  # stripe width at plan time (not failover)
+        self.ranges: List[_Range] = []
+        self.conns: Dict[P.Connection, str] = {}  # participating sources
+        self.addrs: List[str] = []                # every candidate source
+        self.failed_addrs: set = set()
+        self.started = False
+        # serializes chunk writes + range bookkeeping against the abort
+        # path's buf=None + arena delete and against source reassignment —
+        # a copy into a freed (and possibly reallocated) arena slot would
+        # corrupt another object
         self.buf_lock = threading.Lock()
 
 
 class ObjectPuller:
-    """Pulls objects from peers' TransferServers into a local shm store."""
+    """Pulls objects from peers' TransferServers into a local shm store.
+
+    ``pull`` accepts one address or a holder list; with several holders
+    and a known size, disjoint ranges are striped across up to
+    ``pull_max_sources`` concurrent connections (PullManager analog).
+    """
 
     def __init__(self, io: P.IOLoop, store: ShmObjectStore):
         self._io = io
@@ -126,6 +201,12 @@ class ObjectPuller:
         # send_with_raw guarantees the raw frame directly follows its header
         self._expect: Dict[P.Connection, Tuple[ObjectID, int]] = {}
         self._lock = threading.Lock()
+        # cumulative observability counters (all written on the IO thread
+        # or under pull()'s completion path; read by tests/metrics)
+        self.bytes_by_source: Dict[str, int] = {}
+        self.pulls_completed = 0
+        self.multi_source_pulls = 0
+        self.source_failovers = 0
 
     def _peer(self, addr: str) -> P.Connection:
         with self._lock:
@@ -140,28 +221,41 @@ class ObjectPuller:
             self._conns[addr] = conn
         return conn
 
-    def pull(self, oid: ObjectID, peer_addr: str,
-             timeout: float = 120.0) -> bool:
-        """Blocking: fetch `oid` from the peer into the local store."""
+    def pull(self, oid: ObjectID,
+             peer_addr: Union[str, Sequence[str]],
+             timeout: float = 120.0, size_hint: int = -1) -> bool:
+        """Blocking: fetch ``oid`` into the local store.
+
+        ``peer_addr`` is one transfer address or the holder list from the
+        object directory; ``size_hint`` (the directory's recorded size)
+        enables striping without a metadata round trip.
+        """
         if self._store.contains(oid):
             return True
-        try:
-            conn = self._peer(peer_addr)
-        except OSError:
+        addrs = [peer_addr] if isinstance(peer_addr, str) else \
+            [a for a in peer_addr if a]
+        addrs = list(dict.fromkeys(addrs))
+        if not addrs:
             return False
+        if size_hint <= 0:
+            # a directory entry can carry size 0 before its true size is
+            # learned — that means UNKNOWN, not zero-length (a requested
+            # (0, 0) range would stream no bytes yet still seal)
+            size_hint = -1
         with self._lock:
             st = self._pending.get(oid)
             if st is not None:
                 leader = False
             else:
-                st = self._pending[oid] = _PullState(conn)
+                st = self._pending[oid] = _PullState()
                 leader = True
         if not leader:  # another thread is already pulling this object
             st.done.wait(timeout)
             return st.error is None and self._store.contains(oid)
+        t0 = time.monotonic()
         try:
-            st.conn.send(P.OBJ_PULL, oid.binary())
-            if not st.done.wait(timeout):
+            self._start_pull(st, oid, addrs, size_hint)
+            if st.error is None and not st.done.wait(timeout):
                 st.error = "pull timed out"
         except P.ConnectionLost as e:
             st.error = str(e)
@@ -178,7 +272,77 @@ class ObjectPuller:
                     st.buf = None
                     self._store.delete(oid)
             st.done.set()
-        return st.error is None
+        ok = st.error is None
+        if ok:
+            self._record_pull(st, time.monotonic() - t0)
+        return ok
+
+    def _start_pull(self, st: _PullState, oid: ObjectID,
+                    addrs: List[str], size_hint: int):
+        cfg = get_config()
+        st.addrs = list(addrs)
+        conns: List[Tuple[P.Connection, str]] = []
+        for a in addrs:  # backfill past unreachable holders
+            if len(conns) >= max(1, cfg.pull_max_sources):
+                break
+            try:
+                conns.append((self._peer(a), a))
+            except OSError:
+                st.failed_addrs.add(a)
+        if not conns:
+            st.error = "no reachable sources"
+            return
+        with st.buf_lock:
+            if size_hint >= max(cfg.pull_min_stripe_bytes, 1) and \
+                    len(conns) > 1:
+                # contiguous stripes, chunk-aligned so server-side chunking
+                # stays on chunk boundaries
+                cs = min(cfg.object_transfer_chunk_bytes, 1 << 20)
+                per = ((size_hint + len(conns) - 1) // len(conns)
+                       + cs - 1) // cs * cs
+                start = 0
+                for conn, addr in conns:
+                    if start >= size_hint:
+                        break
+                    length = min(per, size_hint - start)
+                    st.ranges.append(_Range(start, length, addr))
+                    st.conns[conn] = addr
+                    start += length
+            else:
+                conn, addr = conns[0]
+                st.ranges.append(_Range(0, size_hint if size_hint >= 0
+                                        else -1, addr))
+                st.conns[conn] = addr
+            st.started = True
+            st.planned_sources = len({r.addr for r in st.ranges})
+            plan = [(c, a, r) for r in st.ranges
+                    for c, a in conns if a == r.addr]
+        for conn, _addr, r in plan:
+            try:
+                conn.send(P.OBJ_PULL, oid.binary(), r.start, r.length)
+            except P.ConnectionLost:
+                # the IO loop may not have noticed the death yet — run the
+                # failover path ourselves (idempotent with on_close)
+                self._handle_conn_failure(conn)
+
+    def _record_pull(self, st: _PullState, latency_s: float):
+        # planned stripe width, NOT len({r.addr}): a failover replacement
+        # range adds a second addr without the sources ever streaming
+        # concurrently — counting it would conflate failover with striping
+        n_sources = st.planned_sources or 1
+        self.pulls_completed += 1
+        if n_sources > 1:
+            self.multi_source_pulls += 1
+        try:
+            from ray_tpu.metrics import object_plane_metrics
+
+            m = object_plane_metrics()
+            tags = {"source_count": str(n_sources)}
+            m["pulls"].inc(1, tags)
+            m["pull_bytes"].inc(max(st.size, 0), tags)
+            m["pull_latency"].observe(latency_s)
+        except Exception:  # noqa: BLE001 — metrics must never fail a pull
+            pass
 
     # ---- everything below runs on the IO thread, in stream order ----
 
@@ -191,32 +355,44 @@ class ObjectPuller:
             if st is None:
                 return
             if size < 0:
-                st.error = "object not on peer"
-                st.done.set()
+                # stale directory entry: this source no longer holds THIS
+                # object — fail over this pull's ranges only. The
+                # connection itself is healthy and may be mid-stream for
+                # other objects; failing those too would poison their
+                # source sets.
+                self._handle_conn_failure(conn, reason="object not on peer",
+                                          only_oid=oid)
                 return
-            try:
-                st.buf = self._store.create(oid, size, len(meta))
-            except ObjectExistsError:
-                if self._store.contains(oid):  # already sealed locally
-                    st.done.set()
-                    return
-                # unsealed leftover from a failed earlier pull: reclaim
-                self._store.delete(oid)
+            with st.buf_lock:
+                if st.size >= 0:
+                    return  # another source's META already sized the buffer
+                st.size = size
+                for r in st.ranges:
+                    if r.length < 0:  # open-ended request, now resolvable
+                        r.length = size - r.start
                 try:
                     st.buf = self._store.create(oid, size, len(meta))
-                except Exception as e:  # noqa: BLE001
+                except ObjectExistsError:
+                    if self._store.contains(oid):  # already sealed locally
+                        st.done.set()
+                        return
+                    # unsealed leftover from a failed earlier pull: reclaim
+                    self._store.delete(oid)
+                    try:
+                        st.buf = self._store.create(oid, size, len(meta))
+                    except Exception as e:  # noqa: BLE001
+                        st.error = f"create failed: {e}"
+                        st.done.set()
+                        return
+                except Exception as e:  # noqa: BLE001 — e.g. store full
                     st.error = f"create failed: {e}"
                     st.done.set()
                     return
-            except Exception as e:  # noqa: BLE001 — e.g. store full
-                st.error = f"create failed: {e}"
-                st.done.set()
-                return
-            st.buf[size:] = meta
-            if size == 0:
-                st.buf = None
-                self._store.seal(oid)
-                st.done.set()
+                st.buf[size:] = meta
+                if size == 0:
+                    st.buf = None
+                    self._store.seal(oid)
+                    st.done.set()
         elif mt == P.OBJ_PULL_CHUNK:
             self._expect[conn] = (ObjectID(msg[2]), msg[3])
         elif mt == P.RAW_FRAME:
@@ -227,40 +403,169 @@ class ObjectPuller:
             payload = msg[2]
             with self._lock:
                 st = self._pending.get(oid)
-            if st is not None:
-                with st.buf_lock:
-                    buf = st.buf
-                    if buf is not None:
-                        import numpy as np
-
-                        # vectorized copy into the arena (~2x a memoryview
-                        # slice assignment; this is the receive-side hot
-                        # loop). payload may be a memoryview into the recv
-                        # buffer (feed()'s zero-copy fast path) — consumed
-                        # before returning.
-                        np.copyto(
-                            np.frombuffer(buf[off:off + len(payload)],
-                                          np.uint8),
-                            np.frombuffer(payload, np.uint8))
+            if st is None:
+                return
+            n = len(payload)
+            with st.buf_lock:
+                buf = st.buf
+                addr = st.conns.get(conn)
+                if buf is not None:
+                    # vectorized copy into the arena (~2x a memoryview
+                    # slice assignment; this is the receive-side hot
+                    # loop). payload may be a memoryview into the recv
+                    # buffer (feed()'s zero-copy fast path) — consumed
+                    # before returning.
+                    np.copyto(
+                        np.frombuffer(buf[off:off + n], np.uint8),
+                        np.frombuffer(payload, np.uint8))
+                    # per-range progress, for resume-after-source-death.
+                    # Match by source + containment (ranges are disjoint
+                    # per source), NOT just expected-next-offset: at a
+                    # stripe boundary the next range's first chunk lands
+                    # exactly at start+received of a finished-but-not-DONE
+                    # neighbour and must not be credited to it. Chunks
+                    # within one range arrive in stream order, so the
+                    # received high-water mark only advances on the next
+                    # expected offset.
+                    if addr is not None:
+                        for r in st.ranges:
+                            if r.done or r.addr != addr or off < r.start:
+                                continue
+                            if r.length >= 0 and off >= r.start + r.length:
+                                continue
+                            if off == r.start + r.received:
+                                r.received += n
+                            break
+            if addr is not None:
+                # sole writer is this IO thread — plain dict update is safe
+                self.bytes_by_source[addr] = \
+                    self.bytes_by_source.get(addr, 0) + n
         elif mt == P.OBJ_PULL_DONE:
             oid = ObjectID(msg[2])
+            start = msg[3] if len(msg) > 3 else 0
             with self._lock:
                 st = self._pending.get(oid)
-            if st is not None and st.buf is not None:
-                st.buf = None  # drop the arena view before sealing
-                try:
-                    self._store.seal(oid)
-                except KeyError:
-                    st.error = "seal failed"
-                st.done.set()
+            if st is None:
+                return
+            with st.buf_lock:
+                for r in st.ranges:
+                    if not r.done and r.start == start:
+                        r.done = True
+                        break
+                self._maybe_seal(st, oid)
+
+    def _maybe_seal(self, st: _PullState, oid: ObjectID):
+        """Seal + wake once every assigned range completed (buf_lock held)."""
+        if st.buf is None or not st.started:
+            return
+        if any(not r.done for r in st.ranges):
+            return
+        st.buf = None  # drop the arena view before sealing
+        try:
+            self._store.seal(oid)
+        except KeyError:
+            st.error = "seal failed"
+        st.done.set()
+
+    # ---- source failure / striped-range failover ----
 
     def _on_conn_close(self, conn: P.Connection):
-        """Peer died mid-pull: fail its pending pulls now, not at timeout."""
+        """A source died: fail over its in-flight ranges now, not at
+        timeout — and drop every per-connection table entry so a recycled
+        Connection object can never route a stale chunk."""
+        self._expect.pop(conn, None)
         with self._lock:
-            stale = [st for st in self._pending.values() if st.conn is conn]
-        for st in stale:
-            st.error = "transfer connection lost"
-            st.done.set()
+            for addr, c in list(self._conns.items()):
+                if c is conn:
+                    del self._conns[addr]
+        self._handle_conn_failure(conn)
+
+    def _handle_conn_failure(self, conn: P.Connection,
+                             reason: str = "transfer connection lost",
+                             only_oid: Optional[ObjectID] = None):
+        """``only_oid`` scopes the failover to one pull (stale directory
+        entry on a live connection); None means the connection died and
+        every pull riding it must reassign."""
+        with self._lock:
+            stale = [(oid, st) for oid, st in self._pending.items()
+                     if conn in st.conns
+                     and (only_oid is None or oid == only_oid)]
+        if not stale:
+            return
+        # Reassignment may dial a NEW source (blocking connect) — never on
+        # the IO thread, which delivers every other connection's bytes.
+        threading.Thread(target=self._failover, args=(conn, stale, reason),
+                         daemon=True).start()
+
+    def _failover(self, dead: P.Connection, stale, reason: str):
+        for oid, st in stale:
+            with st.buf_lock:
+                addr_dead = st.conns.pop(dead, None)
+                if addr_dead is None:
+                    continue  # concurrent failover already handled it
+                st.failed_addrs.add(addr_dead)
+                # Ranges the dead source fully delivered (only the DONE
+                # frame was lost) can close now. Ranges with an undelivered
+                # tail stay NOT-done until their replacement range exists:
+                # marking them done before the reassignment lands would let
+                # a surviving source's OBJ_PULL_DONE seal a partially-
+                # written object in the window between lock holds.
+                broken: List[_Range] = []
+                for r in st.ranges:
+                    if r.done or r.addr != addr_dead:
+                        continue
+                    if r.length >= 0 and r.received >= r.length:
+                        r.done = True
+                        continue
+                    broken.append(r)
+                if not broken:
+                    # the dead source had finished its share — the pull may
+                    # now be complete
+                    self._maybe_seal(st, oid)
+                    continue
+            target = self._pick_failover_source(st)
+            if target is None:
+                st.error = reason
+                st.done.set()
+                continue
+            tconn, taddr = target
+            self.source_failovers += 1
+            plan: List[Tuple[int, int]] = []
+            with st.buf_lock:
+                st.conns[tconn] = taddr
+                for r in broken:
+                    # freeze the old range at what actually arrived; its
+                    # undelivered tail becomes a fresh range on the target
+                    # — appended in the SAME lock hold that closes the old
+                    # one, so _maybe_seal never sees a gap
+                    resume = r.start + r.received
+                    remaining = (r.length - r.received) if r.length >= 0 \
+                        else -1
+                    r.length = r.received
+                    r.done = True
+                    st.ranges.append(_Range(resume, remaining, taddr))
+                    plan.append((resume, remaining))
+            try:
+                for resume, remaining in plan:
+                    tconn.send(P.OBJ_PULL, oid.binary(), resume, remaining)
+            except P.ConnectionLost:
+                self._handle_conn_failure(tconn)
+
+    def _pick_failover_source(self, st: _PullState):
+        """A surviving participant, else an untried candidate address."""
+        with st.buf_lock:
+            for c, a in st.conns.items():
+                if not c.closed:
+                    return c, a
+            candidates = [a for a in st.addrs if a not in st.failed_addrs
+                          and a not in st.conns.values()]
+        for a in candidates:
+            try:
+                return self._peer(a), a
+            except OSError:
+                with st.buf_lock:
+                    st.failed_addrs.add(a)
+        return None
 
     def close(self):
         with self._lock:

@@ -85,11 +85,17 @@ PUSH_TASK_BATCH = 54    # ([task_specs],) one frame, one pickle, one syscall
 
 # peer-to-peer object transfer (object_transfer.py; the reference's
 # ObjectManagerService chunked pull, object_manager.proto:61)
-PULL_OBJECT = 56        # head->agent: (oid_bin, peer_transfer_addr) -> ok
-OBJ_PULL = 57           # puller->server, one-way: (oid_bin)
-OBJ_PULL_CHUNK = 58     # server->puller header: (oid_bin, offset, size);
+PULL_OBJECT = 56        # head->agent: (oid_bin, [holder_addrs], size) -> ok
+#                         (a single addr string is accepted for compat)
+OBJ_PULL = 57           # puller->server, one-way: (oid_bin, start, length);
+#                         length -1 = "through end of object". Disjoint
+#                         ranges of one object may be requested from
+#                         different holders concurrently (striped pull,
+#                         the reference's PullManager chunk fan-out).
+OBJ_PULL_CHUNK = 58     # server->puller header: (oid_bin, offset);
 #                         the chunk bytes follow as ONE raw frame
-OBJ_PULL_DONE = 59      # server->puller: (oid_bin)
+OBJ_PULL_DONE = 59      # server->puller: (oid_bin, start, length) — the
+#                         requested range has been fully streamed
 RAW_FRAME = 60          # synthetic msg type for raw frames: (RAW_FRAME, 0, bytes)
 OBJ_PULL_META = 61      # server->puller: (oid_bin, size|-1, meta_bytes)
 OBJECT_RECOVERING = 62  # owner->head: ([oid_bins],) lineage re-execution began
@@ -108,6 +114,16 @@ XLANG_CALL = 67         # (json_bytes,) cross-language frontend (C++ task
                         # with a RAW frame of JSON {"rid", "status",
                         # "result"|"error"} (raw so non-Python clients
                         # never parse pickle)
+OBJ_LOCATION_ADD = 68   # (oid_bin, node_idx, size) a node gained a copy
+                        # (pull completion / replica creation) — the head
+                        # adds it to the object directory's holder set
+                        # (reference: ObjectDirectory location updates,
+                        # src/ray/object_manager/object_directory.h)
+OBJ_LOCATION_REMOVE = 69  # ([oid_bins], node_idx) a node dropped copies
+                        # (eviction/deletion) — remove from holder sets;
+                        # batched: one message per eviction sweep
+OBJ_LOCATION_LOOKUP = 70  # (oid_bin) -> ([holder_idxs], [transfer_addrs],
+                        # size, spilled_url) full holder-set query
 
 # High bit of the length prefix marks a RAW frame: the payload is
 # unpickled bytes (bulk data follows its pickled header message). Sending

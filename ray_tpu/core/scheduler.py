@@ -234,6 +234,30 @@ class ClusterResourceScheduler:
         k = max(1, int(len(avail) * cfg.scheduler_top_k_fraction))
         return self._rng.choice(avail[:k])
 
+    def best_locality_node(self, request: ResourceSet,
+                           arg_bytes_by_node: Dict[int, int]
+                           ) -> Optional[int]:
+        """Locality-aware placement (reference: LocalityAwareLeasePolicy,
+        locality_aware_lease_policy.h + hybrid policy's locality hook):
+        among schedulable nodes that can run ``request`` RIGHT NOW, pick
+        the one already holding the most argument bytes. Returns None when
+        no holder is feasible+available — the caller falls back to the
+        hybrid/spread policies, so locality is a preference, never a
+        constraint.
+        """
+        best, best_score = None, 0
+        for i in self.schedulable_nodes():
+            score = arg_bytes_by_node.get(i, 0)
+            if score <= 0:
+                continue
+            node = self.nodes.get(i)
+            if node is None or not node.is_available(request):
+                continue
+            if score > best_score or (score == best_score
+                                      and best is not None and i < best):
+                best, best_score = i, score
+        return best
+
     def _spread(self, request: ResourceSet) -> Optional[int]:
         if self._native is not None:
             self._native.sync(self.nodes, self._draining)

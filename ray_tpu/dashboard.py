@@ -136,6 +136,9 @@ class _Handler(BaseHTTPRequestHandler):
                     "placement_groups": state.list_placement_groups,
                     # head event-loop lag (instrumented_io_context analog)
                     "io_loop": lambda limit=10: state.io_loop_stats(),
+                    # object directory + locality/pull counters
+                    "object_plane":
+                        lambda limit=1: state.object_plane_stats(),
                 }.get(kind)
                 if fn is None:
                     self._json({"error": f"unknown endpoint {path}"}, 404)

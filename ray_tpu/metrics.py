@@ -147,6 +147,45 @@ class Histogram(Metric):
         return out
 
 
+# ---------------------------------------------------- object-plane metrics
+
+_object_plane: Optional[Dict[str, Metric]] = None
+_object_plane_lock = threading.Lock()
+
+# pull latency spans shm memcpy (sub-ms) to multi-GiB cross-host (minutes)
+PULL_LATENCY_BOUNDARIES = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                           10.0, 30.0, 60.0)
+
+
+def object_plane_metrics() -> Dict[str, Metric]:
+    """Lazily-created counters for the object data plane (reference:
+    object_manager_stats / pull_manager metrics). ``source_count`` tags
+    split single-source pulls from striped multi-source ones; the head's
+    locality hit/miss counters live head-side and are surfaced through
+    the ``object_plane`` state query instead (the head is the metrics
+    aggregator, not a client)."""
+    global _object_plane
+    if _object_plane is None:
+        with _object_plane_lock:
+            if _object_plane is None:
+                _object_plane = {
+                    "pulls": Counter(
+                        "object_plane.pulls",
+                        "Completed object pulls, by concurrent source "
+                        "count",
+                        tag_keys=("source_count",)),
+                    "pull_bytes": Counter(
+                        "object_plane.pull_bytes",
+                        "Bytes pulled from peer transfer servers",
+                        tag_keys=("source_count",)),
+                    "pull_latency": Histogram(
+                        "object_plane.pull_latency_s",
+                        "End-to-end object pull latency (seconds)",
+                        boundaries=PULL_LATENCY_BOUNDARIES),
+                }
+    return _object_plane
+
+
 # ------------------------------------------------------------- transport
 
 

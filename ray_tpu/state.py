@@ -75,6 +75,14 @@ def list_tasks(filters=None, limit: int = _DEFAULT_LIMIT):
     return _apply_filters(_query("tasks", limit), filters)
 
 
+def object_plane_stats() -> Dict[str, Any]:
+    """Object data-plane snapshot: directory shape (objects, bytes,
+    replicated holder entries), locality-placement hit/miss counters, and
+    head relay bytes (0 when all cross-host traffic rode the P2P plane)."""
+    rows = _query("object_plane", 1)
+    return rows[0] if rows else {}
+
+
 def io_loop_stats() -> List[Dict[str, Any]]:
     """Head event-loop lag counters (analog: the reference's
     instrumented_io_context / event_stats.h per-handler timing):

@@ -38,10 +38,16 @@ def test_lost_object_is_reconstructed(ray_start_cluster, tmp_path):
     assert marker.read_text().count("ran") == 1
 
     cluster.remove_node(idx)
-    # driver-local cached copy would short-circuit the test: drop it
+    # driver-local cached copies would short-circuit the test: drop the
+    # memory-store entry AND the plasma replica the first get() pulled in
+    # (the object directory tracks that replica as a live holder), going
+    # through the real eviction-report path so the head marks the object
+    # lost once its final copy is gone
     ctx = get_context()
     ctx.memory_store.evict(ref.id)
     ctx._pinned.discard(ref.id)
+    ctx.store.delete(ref.id)
+    ctx._report_evictions([ref.id])
 
     arr2 = ray_tpu.get(ref, timeout=120)
     assert np.array_equal(arr2, np.arange(60_000, dtype=np.float64))
@@ -75,8 +81,13 @@ def test_dependent_chain_reconstructed(ray_start_cluster, tmp_path):
     cluster.remove_node(idx)
     ctx = get_context()
     for r in (ref_a, ref_b):
+        # drop every driver-local copy (memory store + directory-tracked
+        # plasma replica) via the eviction-report path — see
+        # test_lost_object_is_reconstructed
         ctx.memory_store.evict(r.id)
         ctx._pinned.discard(r.id)
+        ctx.store.delete(r.id)
+        ctx._report_evictions([r.id])
 
     out = ray_tpu.get(ref_b, timeout=120)
     assert float(out[0]) == 2.0 and out.shape == (60_000,)
