@@ -101,6 +101,14 @@ class Config:
     # --- logging / events ---
     log_dir: str = ""
     task_event_buffer_size: int = 10000
+    # Head-side ring buffer for the structured cluster event log
+    # (reference: the GCS event aggregator behind `ray list
+    # cluster-events`). Overflow drops the oldest and counts the drops.
+    cluster_event_buffer_size: int = 10000
+    # Per-node physical telemetry sampling period (reference:
+    # dashboard/modules/reporter/reporter_agent.py, 2.5s). <= 0 disables
+    # the reporter thread.
+    node_telemetry_period_s: float = 2.0
 
     # --- TPU ---
     # Override autodetected TPU topology, e.g. "v5p-64".

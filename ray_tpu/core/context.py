@@ -675,9 +675,12 @@ class CoreContext:
             retry_exceptions=retry_exceptions,
             owner=self.worker_id,
             runtime_env=runtime_env or self.job_runtime_env,
+            trace_ctx=task_events.submit_trace_ctx(),
         )
         arg_ids, holder = self._encode_args(spec, args, kwargs)
-        self.events.record(task_id.hex(), spec.name, task_events.SUBMITTED)
+        self.events.record(task_id.hex(), spec.name, task_events.SUBMITTED,
+                           trace_id=spec.trace_ctx[0],
+                           parent_span_id=spec.trace_ctx[1])
         return self._enqueue_spec(spec, arg_ids, holder)
 
     def _encode_args(self, spec: TaskSpec, args, kwargs):
@@ -1177,6 +1180,7 @@ class CoreContext:
             max_restarts=max_restarts, max_concurrency=max_concurrency,
             max_retries=max_task_retries,
             runtime_env=runtime_env or self.job_runtime_env,
+            trace_ctx=task_events.submit_trace_ctx(),
         )
         self._encode_args(spec, args, kwargs)
         self.head.call(P.CREATE_ACTOR, dumps(spec), timeout=60)
@@ -1212,6 +1216,7 @@ class CoreContext:
             name=method_name, function_id="", method_name=method_name,
             num_returns=num_returns, owner=self.worker_id,
             actor_id=actor_id, max_retries=max_retries,
+            trace_ctx=task_events.submit_trace_ctx(),
         )
         arg_ids, holder = self._encode_args(spec, args, kwargs)
         refs = [ObjectRef(oid, self.worker_id, _register=False)
@@ -1484,18 +1489,37 @@ class CoreContext:
 
     def _execute(self, spec: TaskSpec, conn: P.Connection):
         """Run one task; returns the TASK_REPLY fields (or None when the
-        reply was already sent inline — creation/terminate paths)."""
+        reply was already sent inline — creation/terminate paths).
+
+        The execution is auto-wrapped in a trace span parented to the
+        submit site (spec.trace_ctx): the task's RUNNING->FINISHED pair
+        IS the span, and the ambient trace context is installed for the
+        duration so tracing.span() inside user code nests under it
+        (reference: tracing_helper.py _inject_tracing_into_function)."""
         label = spec.name or spec.method_name or spec.function_id
-        self.events.record(spec.task_id.hex(), label, task_events.RUNNING)
-        out = self._execute_inner(spec, conn)
+        trace_id, parent_id = spec.trace_ctx or ("", "")
+        span_id = task_events.new_span_id() if trace_id else ""
+        self.events.record(spec.task_id.hex(), label, task_events.RUNNING,
+                           trace_id=trace_id, span_id=span_id,
+                           parent_span_id=parent_id)
+        prev = task_events.set_trace(
+            (trace_id, span_id) if trace_id else None)
+        try:
+            out = self._execute_inner(spec, conn)
+        finally:
+            task_events.set_trace(prev)
         if out is None or out[1] == "ok":
             self.events.record(spec.task_id.hex(), label,
-                               task_events.FINISHED)
+                               task_events.FINISHED,
+                               trace_id=trace_id, span_id=span_id,
+                               parent_span_id=parent_id)
         else:
             self.events.record(
                 spec.task_id.hex(), label,
                 task_events.FAILED if out[1] == "error" else out[1].upper(),
-                error=repr(out[3]) if out[3] is not None else "")
+                error=repr(out[3]) if out[3] is not None else "",
+                trace_id=trace_id, span_id=span_id,
+                parent_span_id=parent_id)
         return out
 
     def _execute_inner(self, spec: TaskSpec, conn: P.Connection):

@@ -9,14 +9,26 @@ TaskEventBuffer; a daemon flusher batches events to the head over the
 existing connection (P.TASK_EVENTS), and the head keeps a bounded deque the
 state API queries. Overflow drops the oldest events and counts the drops —
 observability must never backpressure the task path.
+
+This module also owns the two companions of that channel:
+
+* the ambient TRACE CONTEXT (reference: tracing_helper.py propagating
+  OpenTelemetry span context across task submission) — a thread-local
+  ``(trace_id, span_id)`` pair that task submission stamps into specs and
+  task execution restores, so spans opened inside a remote task nest
+  under the submitting span;
+* the CLUSTER EVENT emitter (reference: the GCS structured event log
+  behind ``ray list cluster-events``) — severity-tagged records any
+  process can push to the head's bounded ring buffer.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+import uuid
 from collections import deque
-from typing import Optional
+from typing import Optional, Tuple
 
 from . import protocol as P
 from .config import get_config
@@ -27,11 +39,59 @@ RUNNING = "RUNNING"
 FINISHED = "FINISHED"
 FAILED = "FAILED"
 
+# cluster-event severities (reference: src/ray/protobuf/
+# export_event.proto severity levels)
+INFO = "INFO"
+WARNING = "WARNING"
+ERROR = "ERROR"
+
 FLUSH_PERIOD_S = 1.0
 
 
+# --------------------------------------------------------- trace context
+#
+# The ambient span context of the CURRENT thread: (trace_id, span_id).
+# tracing.span() pushes/pops it; the executor installs the task's span
+# for the duration of user code; submission reads it to stamp specs.
+
+_trace_tls = threading.local()
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def current_trace() -> Optional[Tuple[str, str]]:
+    """(trace_id, span_id) of the active span, or None."""
+    return getattr(_trace_tls, "ctx", None)
+
+
+def set_trace(ctx: Optional[Tuple[str, str]]) -> Optional[Tuple[str, str]]:
+    """Install an ambient span context; returns the previous one so the
+    caller can restore it (executor entry/exit, span scopes)."""
+    prev = getattr(_trace_tls, "ctx", None)
+    _trace_tls.ctx = ctx
+    return prev
+
+
+def submit_trace_ctx() -> Tuple[str, str]:
+    """Trace context to stamp into a task spec at submission: the active
+    span's (trace_id, span_id), or a fresh root trace when the submit
+    site has no span — every task then belongs to SOME trace, so spans
+    opened inside it share one trace_id with the task."""
+    ctx = current_trace()
+    if ctx is not None:
+        return ctx
+    return (uuid.uuid4().hex, "")
+
+
 class TaskEventBuffer:
-    """Owner/executor-side event buffer with periodic batched flush."""
+    """Owner/executor-side event buffer with periodic batched flush.
+
+    Event tuples are ``(task_id_hex, name, state, worker_id, node_idx,
+    ts, error, trace_id, span_id, parent_span_id)`` — the trailing three
+    carry the cross-process trace tree (empty strings when untraced).
+    """
 
     def __init__(self, head_conn, worker_id: str, node_idx: int):
         self._head = head_conn
@@ -43,6 +103,11 @@ class TaskEventBuffer:
         # (a mutex here measurably dents the async-task benchmark).
         self._events: "deque" = deque(maxlen=self._max)
         self._dropped = 0  # approximate (see record)
+        # serializes drain+send across the periodic flusher and sync
+        # flushes — without it a sync flush can find the deque already
+        # drained by a preempted flusher whose send hasn't happened yet,
+        # ack an empty batch, and break the ordering barrier
+        self._flush_lock = threading.Lock()
         self._stop = threading.Event()
         self._flusher: Optional[threading.Thread] = None
 
@@ -52,9 +117,10 @@ class TaskEventBuffer:
         self._flusher.start()
 
     def record(self, task_id_hex: str, name: str, state: str,
-               error: str = ""):
+               error: str = "", trace_id: str = "", span_id: str = "",
+               parent_span_id: str = ""):
         ev = (task_id_hex, name, state, self._worker_id, self._node_idx,
-              time.time(), error)
+              time.time(), error, trace_id, span_id, parent_span_id)
         if len(self._events) == self._max:
             self._dropped += 1  # deque(maxlen) evicts the oldest
         self._events.append(ev)
@@ -63,21 +129,64 @@ class TaskEventBuffer:
         while not self._stop.wait(FLUSH_PERIOD_S):
             self.flush()
 
-    def flush(self):
-        if not self._events:
+    def flush(self, sync: bool = False):
+        """Push buffered events to the head. ``sync=True`` round-trips
+        (the head replies only after ingesting the batch), making the
+        flush an ordering barrier: a STATE_QUERY issued afterwards — on
+        any connection — observes these events. Used by timeline() in
+        place of the old sleep-and-hope."""
+        if not self._events and not sync:
             return
-        batch = []
-        try:
-            while True:
-                batch.append(self._events.popleft())
-        except IndexError:
-            pass
-        dropped, self._dropped = self._dropped, 0
-        try:
-            self._head.send(P.TASK_EVENTS, batch, dropped)
-        except P.ConnectionLost:
-            pass
+        with self._flush_lock:
+            batch = []
+            try:
+                while True:
+                    batch.append(self._events.popleft())
+            except IndexError:
+                pass
+            dropped, self._dropped = self._dropped, 0
+            try:
+                if sync:
+                    self._head.call(P.TASK_EVENTS, batch, dropped,
+                                    timeout=30)
+                else:
+                    self._head.send(P.TASK_EVENTS, batch, dropped)
+            except P.ConnectionLost:
+                pass
 
     def stop(self):
         self._stop.set()
         self.flush()
+
+
+# --------------------------------------------------------- cluster events
+
+
+def make_cluster_event(severity: str, source: str, event_type: str,
+                       message: str, *, node_idx: int = -1,
+                       entity_id: str = "", extra: Optional[dict] = None
+                       ) -> tuple:
+    """Wire tuple for one cluster event record."""
+    return (time.time(), severity, source, node_idx, entity_id,
+            event_type, message, dict(extra or {}))
+
+
+def emit_cluster_event(severity: str, source: str, event_type: str,
+                       message: str, *, node_idx: Optional[int] = None,
+                       entity_id: str = "", extra: Optional[dict] = None):
+    """Fire-and-forget a cluster event from any process with a live
+    CoreContext (drivers, workers, actors — e.g. the job manager).
+    Head-side code appends to the ring buffer directly instead."""
+    from .context import get_context_if_exists
+
+    ctx = get_context_if_exists()
+    if ctx is None:
+        return
+    ev = make_cluster_event(
+        severity, source, event_type, message,
+        node_idx=ctx.node_idx if node_idx is None else node_idx,
+        entity_id=entity_id, extra=extra)
+    try:
+        ctx.head.send(P.CLUSTER_EVENT, [ev], 0)
+    except P.ConnectionLost:
+        pass

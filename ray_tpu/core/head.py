@@ -182,6 +182,17 @@ class Head:
         self.task_events: "deque" = deque(
             maxlen=get_config().task_event_buffer_size)
         self.task_events_dropped = 0
+        # Structured cluster event log (reference: the GCS event
+        # aggregator behind `ray list cluster-events`): severity-tagged
+        # records from head-side emitters and any process's
+        # CLUSTER_EVENT pushes, bounded with drop counting.
+        self.cluster_events: "deque" = deque(
+            maxlen=get_config().cluster_event_buffer_size)
+        self.cluster_events_dropped = 0
+        # last node.* telemetry gauges per node (reporter.py rows),
+        # mirrored into list_nodes() rows
+        self.node_telemetry: Dict[int, dict] = {}
+        self._telemetry = None  # NodeTelemetryReporter, started in start()
         # cluster-merged metrics: (name, tags_key) -> row dict
         self.metrics: Dict[tuple, dict] = {}
         # auto-names for actors created by non-Python frontends
@@ -223,6 +234,20 @@ class Head:
         self._housekeeper = threading.Thread(
             target=self._housekeeping_loop, daemon=True, name="head-keeper")
         self._housekeeper.start()
+        # Physical telemetry for the head host, published per local
+        # logical node (reference: reporter_agent.py; remote hosts run
+        # their own reporter inside the node agent).
+        from .reporter import NodeTelemetryReporter
+
+        def _local_nodes():
+            with self._lock:
+                return [(n.idx, n.store) for n in self.nodes.values()
+                        if n.alive and not n.is_remote]
+
+        self._telemetry = NodeTelemetryReporter(
+            lambda batch: self._h_metrics_report(None, 0, batch),
+            _local_nodes)
+        self._telemetry.start()
         # Worker spawner thread: fork+exec of an interpreter costs
         # 20-300 ms of syscalls — measured blocking the head IO loop
         # (and the head lock) for exactly that long per spawn when run
@@ -325,6 +350,9 @@ class Head:
         with self._lock:
             self.nodes[idx] = node
             self.scheduler.add_node(idx, nr)
+        self.emit_event("INFO", "head", "node_registered",
+                        f"local node {idx} registered", node_idx=idx,
+                        extra={"resources": nr.total.to_dict()})
         self._flush_restored()
         return idx
 
@@ -378,6 +406,11 @@ class Head:
             self.scheduler.add_node(idx, resources)
         conn.peer = f"agent:node{idx}"
         conn.on_close = lambda c, i=idx: self._on_agent_close(i)
+        self.emit_event("INFO", "head", "node_registered",
+                        f"remote node {idx} joined from {node_ip}",
+                        node_idx=idx,
+                        extra={"node_ip": node_ip,
+                               "resources": resources.total.to_dict()})
         self._publish("node_added", dumps(idx))
         self._flush_restored()
         return idx
@@ -400,9 +433,27 @@ class Head:
         with self._lock:
             node = self.nodes.pop(idx, None)
             self.scheduler.remove_node(idx)
+            self.node_telemetry.pop(idx, None)
+            # prune the node's telemetry gauges from the merged metric
+            # table too — a dead host must not keep exporting
+            # fresh-looking node_cpu_percent rows to scrapers forever
+            # (match on the reserved {"node": idx} tag shape so user
+            # metrics merely named node.* are untouched)
+            for key in [k for k, row in self.metrics.items()
+                        if k[0].startswith("node.")
+                        and row["tags"] == {"node": str(idx)}]:
+                del self.metrics[key]
         if node is None:
             return
         node.alive = False
+        self.emit_event(
+            "ERROR", "head", "node_dead",
+            f"node {idx} removed"
+            + (" (agent lost/evicted)" if node.is_remote else ""),
+            node_idx=idx,
+            extra={"is_remote": node.is_remote,
+                   "workers_killed": len(node.workers)
+                   if kill_workers else 0})
         if kill_workers:
             for w in list(node.workers.values()):
                 self._kill_worker_process(w)
@@ -883,6 +934,12 @@ class Head:
 
     def _handle_worker_death(self, w: WorkerInfo):
         with self._lock:
+            # already "dead" => a deliberate kill (_kill_worker_process
+            # ran first: kill(), OOM policy); only UNEXPECTED deaths log
+            # a worker_died event — deliberate paths log their own
+            # (actor_dead, worker_oom_kill), and a duplicate WARNING
+            # here would false-alarm severity-based alerting
+            unexpected = w.state != "dead"
             w.state = "dead"
             node = self.nodes.get(w.node_idx)
             if node:
@@ -898,6 +955,10 @@ class Head:
                         node.resources.release(request)
                     self._release_tpu_chips(node, tpu_ids)
             actor_id = w.actor_id
+        if unexpected:
+            self.emit_event("WARNING", "head", "worker_died",
+                            f"worker {w.worker_id[:8]} died",
+                            node_idx=w.node_idx, entity_id=w.worker_id)
         if actor_id is not None:
             self._on_actor_worker_death(actor_id)
         self._publish("worker_failed", dumps(w.worker_id))
@@ -997,6 +1058,16 @@ class Head:
                 waiters = list(info.pending_get_replies)
                 info.pending_get_replies.clear()
                 state, payload = "ALIVE", info.listen_addr
+        if state == "ALIVE":
+            self.emit_event(
+                "INFO", "head", "actor_created",
+                f"actor {info.spec.class_name or '?'} "
+                f"{w.actor_id.hex()[:8]} alive",
+                node_idx=w.node_idx, entity_id=w.actor_id.hex())
+        else:
+            self.emit_event("ERROR", "head", "actor_dead", payload,
+                            node_idx=w.node_idx,
+                            entity_id=w.actor_id.hex())
         for wconn, wrid in waiters:
             try:
                 wconn.reply(wrid, state, payload,
@@ -1028,9 +1099,19 @@ class Head:
                 info.death_cause = "worker died"
                 self._release_actor_name(info)
         if info.state == "RESTARTING":
+            self.emit_event(
+                "WARNING", "head", "actor_restarted",
+                f"actor {actor_id.hex()[:8]} restarting "
+                f"({info.restarts_used} used)",
+                entity_id=actor_id.hex(),
+                extra={"restarts_used": info.restarts_used})
             self._publish(f"actor:{actor_id.hex()}", dumps(("RESTARTING", "")))
             self._schedule_actor(info)
         else:
+            self.emit_event("ERROR", "head", "actor_dead",
+                            f"actor {actor_id.hex()[:8]} dead: "
+                            f"{info.death_cause}",
+                            entity_id=actor_id.hex())
             self._publish(f"actor:{actor_id.hex()}",
                           dumps(("DEAD", info.death_cause)))
 
@@ -1041,6 +1122,9 @@ class Head:
             waiters = list(info.pending_get_replies)
             info.pending_get_replies.clear()
             self._release_actor_name(info)
+        self.emit_event("ERROR", "head", "actor_dead",
+                        f"actor {info.actor_id.hex()[:8]} dead: {cause}",
+                        entity_id=info.actor_id.hex())
         for wconn, wrid in waiters:
             try:
                 wconn.reply(wrid, "DEAD", cause,
@@ -1122,6 +1206,9 @@ class Head:
                 if node is not None:
                     node.workers.pop(w.worker_id, None)
         if no_restart:
+            self.emit_event("ERROR", "head", "actor_dead",
+                            f"actor {aid.hex()[:8]} killed via kill()",
+                            entity_id=aid.hex())
             self._publish(f"actor:{aid.hex()}",
                           dumps(("DEAD", "killed via kill()")))
         if rid > 0:
@@ -1140,6 +1227,11 @@ class Head:
                         for i in self.scheduler.schedulable_nodes())
                     for b in spec.bundles)
                 if not feasible:
+                    self.emit_event(
+                        "ERROR", "head", "pg_infeasible",
+                        f"placement group {spec.pg_id.hex()[:8]} "
+                        "infeasible: no node can ever fit some bundle",
+                        entity_id=spec.pg_id.hex())
                     # not persisted: the client sees an error, so a restart
                     # must not resurrect a phantom group
                     conn.reply_error(rid, RuntimeError(
@@ -1171,6 +1263,11 @@ class Head:
             info.bundle_available.append(rs)
         info.state = "CREATED"
         self.pgs[spec.pg_id] = info
+        self.emit_event("INFO", "head", "pg_ready",
+                        f"placement group {spec.pg_id.hex()[:8]} ready on "
+                        f"nodes {placement}",
+                        entity_id=spec.pg_id.hex(),
+                        extra={"placement": list(placement)})
         # mirror into KV: non-driver processes poll kv_get("pg_state", ...)
         # from PlacementGroup.ready() (api.py _pg_state)
         self.kv.setdefault("pg_state", {})[spec.pg_id.hex()] = b"CREATED"
@@ -1737,6 +1834,7 @@ class Head:
                 if loc.node_idx == node_idx and not loc.spilled_path
             ]
         target = store.capacity() * (cfg.object_spilling_threshold - 0.2)
+        spilled_n, spilled_bytes = 0, 0
         for oid, loc in candidates:
             if store.bytes_in_use() <= target:
                 break
@@ -1760,6 +1858,15 @@ class Head:
                 # back to the spill file when no arena copy remains
                 loc.node_idx = min(loc.holders) if loc.holders else -1
             store.delete(oid)
+            spilled_n += 1
+            spilled_bytes += loc.size
+        if spilled_n:
+            self.emit_event(
+                "WARNING", "head", "object_spill",
+                f"spilled {spilled_n} objects "
+                f"({spilled_bytes} bytes) from node {node_idx} arena",
+                node_idx=node_idx,
+                extra={"objects": spilled_n, "bytes": spilled_bytes})
 
     # ------------------------------------------------------------ cluster info
 
@@ -1770,6 +1877,21 @@ class Head:
         gauges overwrite."""
         with self._lock:
             for kind, name, desc, meta, tags_key, value in batch:
+                # reporter telemetry rows are identified by name prefix
+                # AND the reserved ("node",) tag-key shape, so user
+                # metrics that merely start with "node." are untouched
+                is_node_telemetry = (kind == "gauge"
+                                     and name.startswith("node.")
+                                     and tuple(meta) == ("node",))
+                if is_node_telemetry:
+                    # drop in-flight reports from nodes already removed
+                    # — merging them would resurrect a dead host's
+                    # gauges post-prune
+                    try:
+                        if int(tags_key[0]) not in self.nodes:
+                            continue
+                    except ValueError:
+                        pass
                 key = (name, tags_key)
                 row = self.metrics.get(key)
                 if row is None:
@@ -1788,6 +1910,16 @@ class Head:
                         continue
                 if kind == "gauge":
                     row["value"] = value
+                    # mirror reporter gauges into the per-node telemetry
+                    # view list_nodes() rows expose
+                    if is_node_telemetry:
+                        try:
+                            nidx = int(tags_key[0])
+                        except ValueError:
+                            pass
+                        else:
+                            self.node_telemetry.setdefault(
+                                nidx, {})[name] = value
                 elif kind == "counter":
                     row["value"] += value
                 else:  # histogram delta: element-wise sum
@@ -1796,10 +1928,54 @@ class Head:
 
     def _h_task_events(self, conn, rid, batch, dropped):
         """Workers' task-state transitions land in a bounded ring buffer
-        (reference: GcsTaskManager; src/ray/gcs/gcs_server/gcs_task_manager.h)."""
+        (reference: GcsTaskManager; src/ray/gcs/gcs_server/gcs_task_manager.h).
+        A request_id means the sender wants a flush-ack: the reply is
+        issued only after ingestion, so a subsequent STATE_QUERY
+        observes this batch (tracing.timeline's ordering barrier)."""
         with self._lock:
+            # count HEAD-ring evictions too (the deque drops oldest
+            # silently) — the satellite drop counters must cover both
+            # the worker buffers and this ring
+            overflow = max(0, len(self.task_events) + len(batch)
+                           - self.task_events.maxlen)
             self.task_events.extend(batch)
-            self.task_events_dropped += dropped
+            self.task_events_dropped += dropped + overflow
+        if rid > 0:
+            conn.reply(rid, True)
+
+    # --------------------------------------------------- cluster events
+
+    def emit_event(self, severity: str, source: str, event_type: str,
+                   message: str, node_idx: int = -1, entity_id: str = "",
+                   extra: Optional[dict] = None):
+        """Head-side cluster event emitter (reference: the GCS writing
+        its own node/actor/job transitions into the event log). Safe
+        under self._lock (RLock) — pure in-memory bookkeeping."""
+        from .events import make_cluster_event
+
+        ev = make_cluster_event(severity, source, event_type, message,
+                                node_idx=node_idx, entity_id=entity_id,
+                                extra=extra)
+        with self._lock:
+            self._append_cluster_event(ev)
+
+    def _append_cluster_event(self, ev: tuple):
+        """Ring append with drop accounting (caller holds the lock) —
+        the ONE place the overflow counter is maintained, shared by the
+        head's own emitters and CLUSTER_EVENT pushes."""
+        if len(self.cluster_events) == self.cluster_events.maxlen:
+            self.cluster_events_dropped += 1
+        self.cluster_events.append(ev)
+
+    def _h_cluster_events(self, conn, rid, batch, dropped=0):
+        """CLUSTER_EVENT pushes from node agents / workers / the job
+        manager merge into the same ring the head's own emitters use."""
+        with self._lock:
+            for ev in batch:
+                self._append_cluster_event(tuple(ev))
+            self.cluster_events_dropped += dropped
+        if rid > 0:
+            conn.reply(rid, True)
 
     def _h_state_query(self, conn, rid, kind, limit):
         """Observability state API (reference: python/ray/util/state/api.py
@@ -1811,6 +1987,9 @@ class Head:
                     "is_remote": n.is_remote, "node_ip": n.node_ip,
                     "resources_total": n.resources.total.to_dict(),
                     "resources_available": n.resources.available.to_dict(),
+                    # last reporter-agent sample for this node (node.*
+                    # gauges; empty until the first telemetry period)
+                    "telemetry": dict(self.node_telemetry.get(n.idx, {})),
                 } for n in self.nodes.values()]
             elif kind == "workers":
                 rows = [{
@@ -1858,29 +2037,60 @@ class Head:
                     "relay_bytes": self.relay_bytes,
                 }]
             elif kind == "metrics":
-                rows = list(self.metrics.values())
+                # merged client metrics plus the head's own ring-buffer
+                # health counters, so silent event drops surface in
+                # metrics_summary() / the Prometheus exposition
+                rows = list(self.metrics.values()) + [
+                    {"name": "head.task_events_dropped",
+                     "kind": "counter",
+                     "description": "Task events dropped by bounded "
+                                    "buffers (worker + head ring)",
+                     "tags": {}, "boundaries": None,
+                     "value": float(self.task_events_dropped)},
+                    {"name": "head.cluster_events_dropped",
+                     "kind": "counter",
+                     "description": "Cluster events dropped by the head "
+                                    "ring buffer",
+                     "tags": {}, "boundaries": None,
+                     "value": float(self.cluster_events_dropped)},
+                ]
             elif kind == "io_loop":
                 # head event-loop lag (analog: the reference's
                 # instrumented_io_context / event_stats.h per-handler
-                # timing surfaced through the debug state endpoints)
-                rows = [dict(loop=self.io.name, **self.io.stats())]
+                # timing surfaced through the debug state endpoints) +
+                # ring-buffer drop counters: overflow of the bounded
+                # event buffers must be detectable, not silent
+                rows = [dict(loop=self.io.name, **self.io.stats(),
+                             task_events_dropped=self.task_events_dropped,
+                             cluster_events_dropped=(
+                                 self.cluster_events_dropped))]
+            elif kind == "cluster_events":
+                # most recent `limit` records, oldest first (the generic
+                # rows[:limit] below then keeps them all)
+                rows = [{
+                    "ts": ts, "severity": sev, "source": src,
+                    "node_idx": nidx, "entity_id": eid, "type": etype,
+                    "message": msg, "extra": extra,
+                } for (ts, sev, src, nidx, eid, etype, msg, extra)
+                    in list(self.cluster_events)[-limit:]]
             elif kind == "task_events":
                 # raw transition log (timeline/tracing export)
                 rows = [{
                     "task_id": tid, "name": name, "state": state,
                     "worker_id": wid, "node_idx": nidx, "ts": ts,
-                    "error": err,
-                } for (tid, name, state, wid, nidx, ts, err)
+                    "error": err, "trace_id": tr, "span_id": sp,
+                    "parent_span_id": psp,
+                } for (tid, name, state, wid, nidx, ts, err, tr, sp, psp)
                     in self.task_events]
             elif kind == "tasks":
                 # newest state wins per task id; newest tasks first
                 latest: Dict[str, dict] = {}
-                for (tid, name, state, wid, nidx, ts, err) in \
-                        self.task_events:
+                for (tid, name, state, wid, nidx, ts, err, tr, sp, psp) \
+                        in self.task_events:
                     latest[tid] = {
                         "task_id": tid, "name": name, "state": state,
                         "worker_id": wid, "node_idx": nidx,
-                        "ts": ts, "error": err,
+                        "ts": ts, "error": err, "trace_id": tr,
                     }
                 rows = list(latest.values())[::-1]
             else:
@@ -2059,6 +2269,7 @@ class Head:
             self._forward_to_worker(owner, P.RECOVER_OBJECT, oid),
         P.REGISTER_NODE: _h_register_node,
         P.TASK_EVENTS: _h_task_events,
+        P.CLUSTER_EVENT: _h_cluster_events,
         P.STATE_QUERY: _h_state_query,
         P.SEAL_ABORTED: _h_seal_aborted,
         P.METRICS_REPORT: _h_metrics_report,
@@ -2184,6 +2395,8 @@ class Head:
         self._shutdown = True
         if self._log_monitor is not None:
             self._log_monitor.stop()
+        if self._telemetry is not None:
+            self._telemetry.stop()
         if getattr(self, "_memory_monitor", None) is not None:
             self._memory_monitor.stop()
         with self._lock:

@@ -14,9 +14,11 @@ import threading
 from typing import Callable, Optional
 
 
-def system_memory_usage_fraction() -> float:
-    """Host memory pressure from /proc/meminfo (MemAvailable-based, the
-    reference's measure — free+cache alone undercounts reclaimable)."""
+def read_meminfo_bytes() -> tuple:
+    """(total_bytes, available_bytes) from /proc/meminfo — the ONE
+    parser shared by the OOM monitor and the telemetry reporter
+    (MemAvailable-based; free+cache alone undercounts reclaimable).
+    (0, 0) when /proc is unreadable."""
     total = avail = None
     try:
         with open("/proc/meminfo") as f:
@@ -28,10 +30,16 @@ def system_memory_usage_fraction() -> float:
                 if total is not None and avail is not None:
                     break
     except OSError:
-        return 0.0
+        return 0, 0
+    return (total or 0) * 1024, (avail or 0) * 1024
+
+
+def system_memory_usage_fraction() -> float:
+    """Host memory pressure from /proc/meminfo."""
+    total, avail = read_meminfo_bytes()
     if not total:
         return 0.0
-    return 1.0 - (avail or 0) / total
+    return 1.0 - avail / total
 
 
 class MemoryMonitor:
@@ -93,6 +101,13 @@ class MemoryMonitor:
               f"{self._threshold:.0%}; killing worker {w.worker_id[:8]} "
               f"(newest busy, retriable) to relieve pressure",
               file=sys.stderr)
+        self._head.emit_event(
+            "ERROR", "memory_monitor", "worker_oom_kill",
+            f"worker {w.worker_id[:8]} killed: host memory at "
+            f"{usage:.0%} >= {self._threshold:.0%}",
+            node_idx=w.node_idx, entity_id=w.worker_id,
+            extra={"usage": round(usage, 4),
+                   "threshold": self._threshold})
         self._head._kill_worker_process(w)
         self._head._handle_worker_death(w)
         with self._head._lock:

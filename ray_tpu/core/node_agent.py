@@ -114,6 +114,28 @@ class NodeAgent:
 
         self.log_monitor = LogMonitor(self.session_dir, _forward)
         self.log_monitor.start()
+        # Physical telemetry for this host -> node.* gauges through the
+        # head's metrics channel (reference: reporter_agent.py).
+        from .reporter import NodeTelemetryReporter
+
+        def _publish_metrics(batch):
+            try:
+                self.head.send(P.METRICS_REPORT, batch)
+            except P.ConnectionLost:
+                pass
+
+        self.telemetry = NodeTelemetryReporter(
+            _publish_metrics,
+            lambda: [(self.node_idx, self.store)])
+        self.telemetry.start()
+        # Worker-crash watcher: the head only learns of a remote worker's
+        # death via its socket close — the structured WHY (exit signal,
+        # OOM kill) is only visible here, next to the process (reference:
+        # the raylet's worker-death reporting + the reporter agent's OOM
+        # detection feeding the event log).
+        self._reaper = threading.Thread(target=self._reap_workers,
+                                        daemon=True, name="agent-reaper")
+        self._reaper.start()
 
     def _read_object(self, oid: ObjectID):
         got = self.store.get(oid)
@@ -250,6 +272,52 @@ class NodeAgent:
             except OSError:
                 pass
 
+    def _reap_workers(self):
+        """Emit a cluster event for every worker that dies WITHOUT the
+        head asking (head-requested kills leave self.workers first, in
+        _kill_worker). Exit by SIGKILL under host memory pressure is
+        classified as an OOM kill — the kernel's oom-killer leaves no
+        other trace than the signal. Pressure is judged by the RECENT
+        PEAK of usage, not the instant of reaping: the kill itself frees
+        the victim's memory, so by the time the poll sees the corpse the
+        live reading is back below threshold."""
+        import signal as _sig
+        from collections import deque as _deque
+
+        from .events import make_cluster_event
+        from .memory_monitor import system_memory_usage_fraction
+
+        oom_threshold = get_config().memory_usage_threshold
+        recent_usage: "_deque" = _deque(maxlen=20)  # ~10s window
+        while not self._shutdown.wait(0.5):
+            recent_usage.append(system_memory_usage_fraction())
+            with self._lock:
+                dead = [(wid, p.returncode) for wid, p in
+                        self.workers.items() if p.poll() is not None]
+                for wid, _ in dead:
+                    self.workers.pop(wid, None)
+            for wid, rc in dead:
+                if rc == 0:
+                    continue  # clean exit (idle reap / graceful terminate)
+                if rc == -_sig.SIGKILL and \
+                        max(recent_usage, default=0.0) >= oom_threshold:
+                    etype, msg = "worker_oom_kill", (
+                        f"worker {wid[:8]} SIGKILLed under host memory "
+                        "pressure (likely kernel oom-killer)")
+                else:
+                    etype, msg = "worker_crash", (
+                        f"worker {wid[:8]} exited unexpectedly "
+                        f"(code {rc})")
+                ev = make_cluster_event(
+                    "ERROR", "node_agent", etype, msg,
+                    node_idx=self.node_idx if self.node_idx is not None
+                    else -1,
+                    entity_id=wid, extra={"exit_code": rc})
+                try:
+                    self.head.send(P.CLUSTER_EVENT, [ev], 0)
+                except P.ConnectionLost:
+                    pass
+
     # ------------------------------------------------------------ lifecycle
 
     def run_forever(self):
@@ -263,6 +331,8 @@ class NodeAgent:
         self._shutdown.set()
         if getattr(self, "log_monitor", None) is not None:
             self.log_monitor.stop()
+        if getattr(self, "telemetry", None) is not None:
+            self.telemetry.stop()
         with self._lock:
             procs = list(self.workers.values())
             self.workers.clear()

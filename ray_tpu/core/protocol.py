@@ -124,6 +124,12 @@ OBJ_LOCATION_REMOVE = 69  # ([oid_bins], node_idx) a node dropped copies
                         # batched: one message per eviction sweep
 OBJ_LOCATION_LOOKUP = 70  # (oid_bin) -> ([holder_idxs], [transfer_addrs],
                         # size, spilled_url) full holder-set query
+CLUSTER_EVENT = 71      # ([(ts, severity, source, node_idx, entity_id,
+                        # type, message, extra)], dropped) severity-tagged
+                        # cluster events -> head ring buffer (reference:
+                        # the GCS cluster event log behind
+                        # `ray list cluster-events`); one-way from any
+                        # process, mirroring the task-event channel
 
 # High bit of the length prefix marks a RAW frame: the payload is
 # unpickled bytes (bulk data follows its pickled header message). Sending

@@ -65,6 +65,11 @@ class TaskSpec:
     max_concurrency: int = 1
     # options
     runtime_env: Optional[dict] = None
+    # caller's active span context, (trace_id, parent_span_id), stamped at
+    # submission so the executing worker parents its task span under the
+    # submit site (reference: tracing_helper.py injecting the OpenTelemetry
+    # context into the task spec's serialized runtime context)
+    trace_ctx: Optional[Tuple[str, str]] = None
     # chip assignment stamped by the head at lease grant (the reference's
     # CUDA_VISIBLE_DEVICES resource-instance ids; exported to the task as
     # TPU_VISIBLE_CHIPS)

@@ -7,7 +7,8 @@ reading the same head tables the state API uses — no aiohttp, no separate
 agent processes. Endpoints:
 
     /api/nodes /api/workers /api/actors /api/tasks /api/objects
-    /api/placement_groups /api/io_loop -> state API rows (JSON)
+    /api/placement_groups /api/io_loop
+    /api/cluster_events     -> state API rows (JSON)
     /api/cluster            -> resource totals/availability
     /api/jobs               -> submitted jobs (jobs.py)
     /api/metrics            -> merged metric rows (JSON)
@@ -134,6 +135,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "tasks": state.list_tasks,
                     "objects": state.list_objects,
                     "placement_groups": state.list_placement_groups,
+                    # severity-tagged structured cluster event log
+                    "cluster_events": state.list_cluster_events,
                     # head event-loop lag (instrumented_io_context analog)
                     "io_loop": lambda limit=10: state.io_loop_stats(),
                     # object directory + locality/pull counters
@@ -178,3 +181,67 @@ def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> Dashboard:
     if not ray_tpu.is_initialized():
         raise RuntimeError("call ray_tpu.init() before start_dashboard()")
     return Dashboard(host, port).start()
+
+
+# Every GET the doctor smoke exercises — keep in sync with _Handler.
+DOCTOR_ENDPOINTS = (
+    "/",
+    "/api/cluster", "/api/nodes", "/api/workers", "/api/actors",
+    "/api/tasks", "/api/objects", "/api/placement_groups",
+    "/api/io_loop", "/api/object_plane", "/api/cluster_events",
+    "/api/metrics", "/api/jobs", "/api/timeline",
+    "/api/summary/tasks", "/api/summary/actors", "/api/summary/objects",
+    "/api/serve/applications",
+    "/metrics",
+)
+
+
+def doctor(verbose: bool = False) -> list:
+    """Dashboard endpoint smoke check (``python -m ray_tpu doctor``):
+    boots a 2-node local cluster when no runtime is up, runs a task so
+    the tables are non-trivial, then GETs every ``/api/*`` endpoint and
+    reports per-endpoint status — anything but a 2xx (500s AND 404s
+    from renamed/removed endpoints) is a failure, so endpoints can't
+    silently rot. Returns ``[{endpoint, status, ok, error}]``."""
+    import urllib.request
+
+    booted = False
+    results = []
+    dash = None
+    try:
+        if not ray_tpu.is_initialized():
+            booted = True  # set BEFORE init: a partial boot must tear down
+            ray_tpu.init(num_cpus=2, num_tpus=0)
+            from ray_tpu.core.api import _head
+
+            _head.add_node(num_cpus=1, num_tpus=0)  # a real 2-node cluster
+        dash = start_dashboard(port=0)
+        # populate task/object/event tables before probing
+        @ray_tpu.remote
+        def _doctor_probe():
+            return 1
+
+        ray_tpu.get([_doctor_probe.remote() for _ in range(2)], timeout=60)
+        for ep in DOCTOR_ENDPOINTS:
+            row = {"endpoint": ep, "status": 0, "ok": False, "error": ""}
+            try:
+                with urllib.request.urlopen(dash.url + ep,
+                                            timeout=60) as resp:
+                    row["status"] = resp.status
+                    body = resp.read()
+                    row["ok"] = 200 <= resp.status < 300 and bool(body)
+            except urllib.error.HTTPError as e:  # non-2xx with a body
+                row["status"], row["error"] = e.code, str(e)
+            except Exception as e:  # noqa: BLE001 — conn refused etc.
+                row["error"] = repr(e)
+            if verbose:
+                mark = "ok " if row["ok"] else "FAIL"
+                print(f"  [{mark}] {row['status'] or '---'} {ep}"
+                      + (f"  {row['error']}" if row["error"] else ""))
+            results.append(row)
+    finally:
+        if dash is not None:
+            dash.stop()
+        if booted:
+            ray_tpu.shutdown()
+    return results

@@ -5,7 +5,8 @@ Ref analog: the reference's React/TS dashboard client
 the same REST endpoints). Re-design: no build toolchain — one hash-routed
 HTML document served by dashboard.py, reading /api/* every 2 s. Pages:
 overview, nodes, actors, tasks (+summary), objects, placement groups,
-jobs, metrics, serve, timeline (SVG lanes over ray_tpu.tracing events).
+jobs, metrics, events (the cluster event log), serve, timeline (SVG
+lanes over ray_tpu.tracing events).
 
 Colors follow a validated light/dark palette (categorical slots for
 timeline lanes, status colors only for alive/dead state, always beside a
@@ -82,7 +83,8 @@ input[type=search] { background: var(--surface-2); border: 1px solid
 <script>
 "use strict";
 const PAGES = ["overview","nodes","actors","tasks","objects",
-               "placement_groups","jobs","metrics","serve","timeline"];
+               "placement_groups","jobs","metrics","events","serve",
+               "timeline"];
 const $ = (s) => document.querySelector(s);
 const esc = (x) => String(x ?? "").replace(/[&<>]/g,
   c => ({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
@@ -101,8 +103,9 @@ async function j(url) {
   return r.json();
 }
 function statusCell(s) {
-  const up = ["ALIVE","RUNNING","READY","FINISHED","CREATED","ok",true];
-  const bad = ["DEAD","FAILED","LOST","error"];
+  const up = ["ALIVE","RUNNING","READY","FINISHED","CREATED","INFO","ok",
+              true];
+  const bad = ["DEAD","FAILED","LOST","ERROR","error"];
   const cls = up.includes(s) ? "ok" : (bad.includes(s) ? "bad" : "warn");
   return `<span class="status ${cls}"><span class="dot"></span>`
        + `${esc(s)}</span>`;
@@ -180,6 +183,18 @@ const RENDER = {
     const rows = await j("/api/metrics");
     return `<h2>metrics</h2>` + table(rows,
       ["name","type","tags","value","description"]);
+  },
+  async events() {
+    const rows = await j("/api/cluster_events");
+    rows.reverse();  // newest first
+    for (const r of rows)
+      r.when = new Date(r.ts * 1000).toLocaleTimeString();
+    const bySev = {};
+    for (const r of rows) bySev[r.severity] = (bySev[r.severity]||0) + 1;
+    return `<h2>cluster events</h2>` +
+      tiles(Object.entries(bySev)) +
+      table(rows, ["when","severity","type","source","node_idx",
+                   "entity_id","message"], ["severity"]);
   },
   async serve() {
     let apps;

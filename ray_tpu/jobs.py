@@ -68,14 +68,29 @@ class _JobManager:
             with self._lock:
                 self._jobs[job_id].update(status=FAILED, message=repr(e),
                                           ended_at=time.time())
+            self._emit_event("ERROR", "job_finished",
+                             f"job {job_id} failed to launch: {e!r}",
+                             job_id)
             return job_id
         with self._lock:
             self._procs[job_id] = proc
             self._jobs[job_id].update(status=RUNNING,
                                       started_at=time.time())
+        self._emit_event("INFO", "job_started",
+                         f"job {job_id} started: {entrypoint}", job_id)
         threading.Thread(target=self._wait, args=(job_id, proc),
                          daemon=True).start()
         return job_id
+
+    @staticmethod
+    def _emit_event(severity: str, event_type: str, message: str,
+                    job_id: str):
+        """Job transitions land in the cluster event log (reference: the
+        GCS job table feeding `ray list cluster-events`)."""
+        from ray_tpu.core import events as _ev
+
+        _ev.emit_cluster_event(severity, "jobs", event_type, message,
+                               entity_id=job_id)
 
     def _wait(self, job_id: str, proc: subprocess.Popen):
         rc = proc.wait()
@@ -86,6 +101,8 @@ class _JobManager:
             info["status"] = SUCCEEDED if rc == 0 else FAILED
             info["message"] = f"exit code {rc}"
             info["ended_at"] = time.time()
+        self._emit_event("INFO" if rc == 0 else "ERROR", "job_finished",
+                         f"job {job_id} finished: exit code {rc}", job_id)
 
     def status(self, job_id: str) -> dict:
         with self._lock:

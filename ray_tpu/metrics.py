@@ -252,6 +252,19 @@ def metrics_summary() -> List[dict]:
     return rows
 
 
+def _escape_label_value(v) -> str:
+    """Escape a label value per the Prometheus text exposition format
+    spec: backslash, double-quote, and line-feed must be escaped (in
+    that order — escaping the backslash first keeps it idempotent)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _label_str(tags: Dict[str, str]) -> str:
+    return ",".join(f'{k}="{_escape_label_value(v)}"'
+                    for k, v in tags.items())
+
+
 def export_prometheus() -> str:
     """Render the head's metric table in Prometheus text exposition
     format (the reference exports via opencensus -> prometheus)."""
@@ -259,7 +272,7 @@ def export_prometheus() -> str:
     for row in metrics_summary():
         name = row["name"].replace(".", "_")
         tags = row["tags"]
-        label = ",".join(f'{k}="{v}"' for k, v in tags.items())
+        label = _label_str(tags)
         label = "{" + label + "}" if label else ""
         if row["kind"] == "histogram":
             h = row["value"]
@@ -267,8 +280,7 @@ def export_prometheus() -> str:
             acc = 0.0
             for b, c in zip(list(bounds) + ["+Inf"], h[:-2]):
                 acc += c
-                lb = dict(tags, le=str(b))
-                ls = ",".join(f'{k}="{v}"' for k, v in lb.items())
+                ls = _label_str(dict(tags, le=str(b)))
                 lines.append(f"{name}_bucket{{{ls}}} {acc:g}")
             lines.append(f"{name}_sum{label} {h[-2]:g}")
             lines.append(f"{name}_count{label} {h[-1]:g}")

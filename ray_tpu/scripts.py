@@ -167,6 +167,23 @@ def cmd_profile(args):
     return 0
 
 
+def cmd_doctor(args):
+    """Boot a 2-node local cluster and smoke every dashboard endpoint;
+    exit non-zero on any 500 (CI guard against endpoint rot)."""
+    from ray_tpu.dashboard import doctor
+
+    results = doctor(verbose=True)
+    bad = [r for r in results if not r["ok"]]
+    print(f"doctor: {len(results) - len(bad)}/{len(results)} endpoints "
+          f"healthy")
+    if bad:
+        for r in bad:
+            print(f"  FAILING: {r['endpoint']} -> {r['status']} "
+                  f"{r['error']}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_list(args):
     from ray_tpu import state as state_api
 
@@ -177,6 +194,7 @@ def cmd_list(args):
         "tasks": state_api.list_tasks,
         "objects": state_api.list_objects,
         "placement-groups": state_api.list_placement_groups,
+        "cluster-events": state_api.list_cluster_events,
     }[args.entity]
     _attached(args)
     rows = fn(limit=args.limit)
@@ -220,10 +238,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_status)
 
+    sp = sub.add_parser(
+        "doctor",
+        help="boot a 2-node cluster and smoke every dashboard endpoint")
+    sp.set_defaults(fn=cmd_doctor)
+
     sp = sub.add_parser("list", help="list cluster entities")
     sp.add_argument("entity", choices=["nodes", "workers", "actors",
                                        "tasks", "objects",
-                                       "placement-groups"])
+                                       "placement-groups",
+                                       "cluster-events"])
     sp.add_argument("--address")
     sp.add_argument("--limit", type=int, default=100)
     sp.set_defaults(fn=cmd_list)

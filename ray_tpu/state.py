@@ -75,6 +75,15 @@ def list_tasks(filters=None, limit: int = _DEFAULT_LIMIT):
     return _apply_filters(_query("tasks", limit), filters)
 
 
+def list_cluster_events(filters=None, limit: int = 1000):
+    """Ref parity: `ray list cluster-events` (util/state/api.py over the
+    GCS event aggregator). Rows are severity-tagged structured records —
+    ``{ts, severity, source, node_idx, entity_id, type, message, extra}``
+    — oldest first; e.g. ``filters=[("severity", "=", "ERROR")]`` or
+    ``[("type", "=", "node_dead")]``."""
+    return _apply_filters(_query("cluster_events", limit), filters)
+
+
 def object_plane_stats() -> Dict[str, Any]:
     """Object data-plane snapshot: directory shape (objects, bytes,
     replicated holder entries), locality-placement hit/miss counters, and
@@ -87,7 +96,9 @@ def io_loop_stats() -> List[Dict[str, Any]]:
     """Head event-loop lag counters (analog: the reference's
     instrumented_io_context / event_stats.h per-handler timing):
     events handled, busy seconds, slow-handler episodes, worst
-    handler time."""
+    handler time — plus the head ring-buffer drop counters
+    (``task_events_dropped`` / ``cluster_events_dropped``), so silent
+    event-buffer overflow is detectable."""
     return _query("io_loop", 10)
 
 

@@ -3,24 +3,39 @@
 Ref parity: ray.timeline() (python/ray/_private/state.py chrome_tracing_dump
 — every task becomes a chrome trace event laid out by worker lane) and the
 span annotations of ray.util.tracing (tracing_helper.py; the reference
-wraps task entry/exit in OpenTelemetry spans). Spans here ride the same
-task-event channel the state API uses — no OpenTelemetry dependency; the
-produced JSON loads in chrome://tracing / Perfetto.
+wraps task entry/exit in OpenTelemetry spans AND propagates the caller's
+span context inside the task spec, so spans nest across processes). Spans
+here ride the same task-event channel the state API uses — no OpenTelemetry
+dependency; the produced JSON loads in chrome://tracing / Perfetto.
+
+Cross-task propagation: every span carries ``trace_id`` / ``span_id`` /
+``parent_span_id``. Task submission stamps the caller's active span
+context into the spec (core/events.py submit_trace_ctx); task execution
+wraps user code in a span parented to the submit site; a ``span()``
+opened inside a remote task therefore shares the submitter's trace_id
+and nests under it — ``timeline()`` exposes the ids via each event's
+``args`` so Perfetto (and tests) can reassemble the cross-process tree.
 """
 
 from __future__ import annotations
 
 import json
-import time
 import uuid
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
+from .core import events as _ev
 from .core import protocol as P
 from .core.context import get_context
 
 SPAN_START = "SPAN_START"
 SPAN_END = "SPAN_END"
+
+
+def current_span_context() -> Optional[tuple]:
+    """The active (trace_id, span_id) of this thread, if any — the task
+    span inside a remote task, or the innermost open span()."""
+    return _ev.current_trace()
 
 
 @contextmanager
@@ -31,25 +46,39 @@ def span(name: str):
 
         with ray_tpu.tracing.span("preprocess"):
             ...
+
+    Inside a remote task the span nests under the task's auto-span (and
+    thus under the submitting span), sharing its trace_id.
     """
     ctx = get_context()
-    span_id = uuid.uuid4().hex[:16]
-    ctx.events.record(span_id, name, SPAN_START)
+    parent = _ev.current_trace()
+    trace_id = parent[0] if parent else uuid.uuid4().hex
+    parent_id = parent[1] if parent else ""
+    span_id = _ev.new_span_id()
+    ctx.events.record(span_id, name, SPAN_START, trace_id=trace_id,
+                      span_id=span_id, parent_span_id=parent_id)
+    prev = _ev.set_trace((trace_id, span_id))
     try:
         yield
     finally:
-        ctx.events.record(span_id, name, SPAN_END)
+        _ev.set_trace(prev)
+        ctx.events.record(span_id, name, SPAN_END, trace_id=trace_id,
+                          span_id=span_id, parent_span_id=parent_id)
 
 
 def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
     """Cluster timeline as chrome-trace events (ref: ray.timeline()).
 
     Task RUNNING->FINISHED/FAILED pairs and span START->END pairs become
-    complete ("X") events; pid = node, tid = worker. Returns the event
-    list; also writes JSON when ``filename`` is given."""
+    complete ("X") events; pid = node, tid = worker; args carry the
+    trace/span ids for traced events. Returns the event list; also
+    writes JSON when ``filename`` is given."""
     ctx = get_context()
-    ctx.events.flush()
-    time.sleep(0.05)  # let the head ingest the tail of the batch
+    # flush-ack: the head replies only after ingesting the batch, so the
+    # STATE_QUERY below is ordered after ingestion (no sleep, no race —
+    # except for OTHER workers' buffers, which flush on their own 1s
+    # period as in the reference).
+    ctx.events.flush(sync=True)
     (rows,) = ctx.head.call(P.STATE_QUERY, "task_events", 1_000_000,
                             timeout=30)
     open_at: Dict[str, dict] = {}
@@ -62,6 +91,13 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
             start = open_at.pop(r["task_id"], None)
             if start is None:
                 continue
+            args: Dict[str, Any] = {}
+            if state == "FAILED":
+                args["error"] = r["error"]
+            if start.get("trace_id"):
+                args["trace_id"] = start["trace_id"]
+                args["span_id"] = start["span_id"]
+                args["parent_span_id"] = start["parent_span_id"]
             events.append({
                 "name": r["name"],
                 "cat": "span" if state == SPAN_END else "task",
@@ -70,8 +106,7 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
                 "dur": max(r["ts"] - start["ts"], 0) * 1e6,
                 "pid": f"node{start['node_idx']}",
                 "tid": f"worker:{start['worker_id'][:8]}",
-                "args": ({"error": r["error"]} if state == "FAILED"
-                         else {}),
+                "args": args,
             })
     if filename:
         with open(filename, "w") as f:

@@ -1,10 +1,14 @@
-"""Observability plane: metrics, tracing/timeline, hung-node eviction.
+"""Observability plane: metrics, tracing/timeline, hung-node eviction,
+cluster event log, node telemetry, cross-task trace propagation.
 
 Analogs of the reference's python/ray/tests/test_metrics_agent.py
 (util.metrics -> exporter), test_global_state.py::test_timeline
-(chrome-trace dump), and the GCS health-check manager behavior
+(chrome-trace dump), the GCS health-check manager behavior
 (src/ray/gcs/gcs_server/gcs_health_check_manager.h:39 — a wedged raylet
-is evicted by probe failures even though its socket stays open).
+is evicted by probe failures even though its socket stays open), the
+cluster event log behind `ray list cluster-events`, the per-node
+reporter agent (dashboard/modules/reporter/reporter_agent.py), and
+tracing_helper.py's span-context propagation across task submission.
 """
 
 import json
@@ -15,7 +19,7 @@ import time
 import pytest
 
 import ray_tpu
-from ray_tpu import metrics, tracing
+from ray_tpu import metrics, state, tracing
 
 
 def test_counter_gauge_merge(ray_start):
@@ -105,6 +109,278 @@ def test_timeline_and_spans(ray_start, tmp_path):
     assert spans and spans[0]["name"] == "driver-section"
     with open(out) as f:
         assert json.load(f)  # valid chrome-trace JSON
+
+
+def test_prometheus_label_escaping(ray_start):
+    """Tag values with quote/backslash/newline must escape per the
+    Prometheus text exposition spec, not emit invalid lines."""
+    c = metrics.Counter("esc.count", tag_keys=("path",))
+    c.inc(1.0, tags={"path": 'a"b\\c\nd'})
+    metrics.flush_now()
+    time.sleep(0.2)
+    text = metrics.export_prometheus()
+    assert 'esc_count{path="a\\"b\\\\c\\nd"} 1' in text
+    # the raw newline must NOT survive: every sample stays on one line
+    assert not any(line.endswith('d"} 1') and "esc_count" not in line
+                   for line in text.splitlines())
+    assert 'b\\c' not in text  # lone backslash was doubled
+
+
+def test_prometheus_escape_helper():
+    from ray_tpu.metrics import _escape_label_value
+
+    assert _escape_label_value('a"b') == 'a\\"b'
+    assert _escape_label_value("a\\b") == "a\\\\b"
+    assert _escape_label_value("a\nb") == "a\\nb"
+    assert _escape_label_value("plain") == "plain"
+
+
+def test_cluster_events_actor_lifecycle(ray_start):
+    """Actor creation/kill lands INFO/ERROR records in the event log,
+    severity- and type-filterable (ref: `ray list cluster-events`)."""
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+    created = state.list_cluster_events(
+        filters=[("type", "=", "actor_created")])
+    assert created and created[0]["severity"] == "INFO"
+    ray_tpu.kill(a)
+    deadline = time.monotonic() + 10
+    dead = []
+    while time.monotonic() < deadline:
+        dead = state.list_cluster_events(
+            filters=[("severity", "=", "ERROR"),
+                     ("type", "=", "actor_dead")])
+        if dead:
+            break
+        time.sleep(0.2)
+    assert dead, "kill() did not emit an actor_dead ERROR event"
+    # node registration from init is in the log too, with the right idx
+    reg = state.list_cluster_events(
+        filters=[("type", "=", "node_registered")])
+    assert any(e["node_idx"] == 0 for e in reg)
+    # every record carries the full structured shape
+    ev = dead[0]
+    for key in ("ts", "severity", "source", "node_idx", "entity_id",
+                "type", "message", "extra"):
+        assert key in ev
+
+
+def test_cluster_event_node_dead_under_chaos():
+    """Kill a node agent process: the head's eviction must log a
+    node_dead ERROR event naming that node (the post-hoc 'what
+    happened' query the event log exists for)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 1, "num_tpus": 0,
+        "_system_config": {"health_check_period_s": 0.3,
+                           "health_check_failure_threshold": 3}})
+    handle = None
+    try:
+        handle = cluster.add_remote_node(num_cpus=1)
+        idx = handle.node_idx
+        reg = state.list_cluster_events(
+            filters=[("type", "=", "node_registered")])
+        assert any(e["node_idx"] == idx for e in reg)
+        handle.terminate()
+        deadline = time.monotonic() + 30
+        dead = []
+        while time.monotonic() < deadline:
+            dead = state.list_cluster_events(
+                filters=[("severity", "=", "ERROR"),
+                         ("type", "=", "node_dead")])
+            if any(e["node_idx"] == idx for e in dead):
+                break
+            time.sleep(0.3)
+        assert any(e["node_idx"] == idx for e in dead), \
+            f"no node_dead ERROR event for node {idx}: {dead}"
+    finally:
+        if handle is not None:
+            handle.terminate()
+        cluster.shutdown()
+
+
+def test_node_gauges_for_every_live_node():
+    """The telemetry reporter publishes node_cpu_percent /
+    node_mem_used_bytes gauges tagged per node, for EVERY live node,
+    into /metrics and the list_nodes() rows."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 1, "num_tpus": 0,
+        "_system_config": {"node_telemetry_period_s": 0.2}})
+    try:
+        cluster.add_node(num_cpus=1)
+        deadline = time.monotonic() + 15
+        per_node = {}
+        while time.monotonic() < deadline:
+            per_node = {
+                r["tags"].get("node"): r
+                for r in metrics.metrics_summary()
+                if r["name"] == "node.cpu_percent"}
+            if {"0", "1"} <= set(per_node):
+                break
+            time.sleep(0.2)
+        assert {"0", "1"} <= set(per_node), per_node
+        text = metrics.export_prometheus()
+        for idx in ("0", "1"):
+            assert f'node_cpu_percent{{node="{idx}"}}' in text
+            assert f'node_mem_used_bytes{{node="{idx}"}}' in text
+        mem = next(r for r in metrics.metrics_summary()
+                   if r["name"] == "node.mem_used_bytes"
+                   and r["tags"].get("node") == "0")
+        assert mem["value"] > 0
+        # list_nodes rows are enriched with the last sample
+        rows = {n["node_idx"]: n for n in state.list_nodes()}
+        for idx in (0, 1):
+            assert "node.cpu_percent" in rows[idx]["telemetry"]
+        # a removed node's gauges are pruned: a dead host must not keep
+        # exporting fresh-looking telemetry to scrapers
+        cluster.remove_node(1)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            live = {r["tags"].get("node")
+                    for r in metrics.metrics_summary()
+                    if r["name"] == "node.cpu_percent"}
+            if "1" not in live:
+                break
+            time.sleep(0.2)
+        assert "1" not in live, live
+        assert 'node_cpu_percent{node="1"}' not in \
+            metrics.export_prometheus()
+    finally:
+        cluster.shutdown()
+
+
+def test_telemetry_reporter_samples_proc():
+    """Unit: the /proc sampler yields sane host numbers without a
+    cluster."""
+    from ray_tpu.core.reporter import NodeTelemetryReporter
+
+    batches = []
+    rep = NodeTelemetryReporter(batches.append, lambda: [(7, None)],
+                                period_s=0)
+    rep.sample_host()          # prime the cpu-delta baseline
+    rep.sample_and_publish()
+    assert batches, "no batch published"
+    rows = {name: (tags_key, value)
+            for (_, name, _, _, tags_key, value) in batches[0]}
+    assert rows["node.cpu_percent"][0] == ("7",)
+    assert 0.0 <= rows["node.cpu_percent"][1] <= 100.0
+    assert rows["node.mem_total_bytes"][1] > 0
+    assert rows["node.mem_used_bytes"][1] > 0
+
+
+def test_nested_cross_task_trace(ray_start):
+    """A span inside a remote task shares the submitting span's
+    trace_id and nests under the task's auto-span, which nests under
+    the submit site (ref: tracing_helper.py context propagation)."""
+    @ray_tpu.remote
+    def traced():
+        with tracing.span("inner"):
+            time.sleep(0.02)
+        return 1
+
+    with tracing.span("outer"):
+        assert ray_tpu.get(traced.remote(), timeout=60) == 1
+
+    deadline = time.monotonic() + 10
+    outer = task = inner = None
+    while time.monotonic() < deadline:
+        ev = tracing.timeline()
+        outer = next((e for e in ev if e["name"] == "outer"), None)
+        task = next((e for e in ev if e["name"] == "traced"), None)
+        inner = next((e for e in ev if e["name"] == "inner"), None)
+        if outer and task and inner:
+            break
+        time.sleep(0.3)
+    assert outer and task and inner
+    o, t, i = outer["args"], task["args"], inner["args"]
+    assert o["trace_id"] == t["trace_id"] == i["trace_id"]
+    assert t["parent_span_id"] == o["span_id"]   # task under submit site
+    assert i["parent_span_id"] == t["span_id"]   # span under task
+    # the task and inner span ran in a different process than the driver
+    assert task["tid"] != outer["tid"]
+
+
+def test_event_drop_counters_surfaced(ray_start):
+    """Ring-buffer overflow must be detectable: drop counters appear in
+    io_loop health output and metrics_summary()."""
+    il = state.io_loop_stats()[0]
+    assert il["task_events_dropped"] == 0
+    assert il["cluster_events_dropped"] == 0
+    rows = {r["name"]: r for r in metrics.metrics_summary()}
+    assert "head.task_events_dropped" in rows
+    assert "head.cluster_events_dropped" in rows
+    # force a cluster-event overflow on the head and watch the counter
+    from ray_tpu.core.api import _head
+
+    maxlen = _head.cluster_events.maxlen
+    for n in range(maxlen + 5):
+        _head.emit_event("INFO", "test", "filler", f"event {n}")
+    il = state.io_loop_stats()[0]
+    assert il["cluster_events_dropped"] >= 5
+    rows = {r["name"]: r for r in metrics.metrics_summary()}
+    assert rows["head.cluster_events_dropped"]["value"] >= 5
+    # head-side task-event ring evictions count too (not just the
+    # worker-buffer drops shipped with each flush)
+    tmax = _head.task_events.maxlen
+    batch = [(f"t{n}", "x", "RUNNING", "w", 0, 0.0, "", "", "", "")
+             for n in range(tmax + 7)]
+    _head._h_task_events(None, 0, batch, 0)
+    il = state.io_loop_stats()[0]
+    assert il["task_events_dropped"] >= 7
+
+
+def test_user_metric_named_node_not_swallowed(ray_start):
+    """Only the reporter's reserved ("node",)-tagged gauges are treated
+    as node telemetry; a user gauge that merely starts with "node." must
+    flow through the normal metrics path untouched."""
+    g = metrics.Gauge("node.queue_depth", tag_keys=("shard",))
+    g.set(3.0, tags={"shard": "5"})  # "5" is not a live node index
+    metrics.flush_now()
+    time.sleep(0.2)
+    row = next((r for r in metrics.metrics_summary()
+                if r["name"] == "node.queue_depth"), None)
+    assert row is not None and row["value"] == 3.0
+    assert all("node.queue_depth" not in n["telemetry"]
+               for n in state.list_nodes())
+
+
+def test_worker_oom_kill_event(ray_start):
+    """The memory monitor's OOM kill logs a worker_oom_kill ERROR event
+    naming the victim worker."""
+    from ray_tpu.core.api import _head
+    from ray_tpu.core.memory_monitor import MemoryMonitor
+
+    @ray_tpu.remote
+    def hold(sec):
+        time.sleep(sec)
+        return 1
+
+    ref = hold.remote(30)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        with _head._lock:
+            busy = [w for n in _head.nodes.values()
+                    for w in n.workers.values() if w.state == "leased"]
+        if busy:
+            break
+        time.sleep(0.1)
+    assert busy, "no leased worker to OOM-kill"
+    mon = MemoryMonitor(_head, usage_fn=lambda: 0.99, period_s=0)
+    mon.check_once()
+    assert mon.kills == 1
+    evs = state.list_cluster_events(
+        filters=[("type", "=", "worker_oom_kill")])
+    assert evs and evs[0]["severity"] == "ERROR"
+    assert evs[0]["entity_id"] in {w.worker_id for w in busy}
+    ray_tpu.cancel(ref)
 
 
 def test_hung_agent_is_evicted():
