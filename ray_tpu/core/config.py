@@ -38,6 +38,28 @@ class Config:
     pull_max_sources: int = 4
     pull_min_stripe_bytes: int = 1 * 1024 * 1024
 
+    # --- wire fast path ---
+    # Small-frame coalescing (protocol.Connection): when several threads
+    # send on one connection concurrently, queued frames are flushed
+    # together in ONE vectored write (socket.sendmsg) by whichever sender
+    # holds the write lock — one syscall instead of one per frame. These
+    # knobs bound a single coalesced flush; an uncontended send is always
+    # flushed immediately (batch of 1), so the idle-connection latency
+    # path is unchanged. The queue reaching wire_coalesce_max_frames also
+    # fires the wire-backpressure cluster event / counter.
+    wire_coalesce_max_bytes: int = 1 * 1024 * 1024
+    wire_coalesce_max_frames: int = 64
+    # Batched task completions (protocol.TASK_DONE_BATCH, the return-side
+    # mirror of PUSH_TASK_BATCH): a worker that finishes several tasks
+    # while more are already queued acks them in one frame — at most this
+    # many completions per frame. Replies flush whenever the worker's
+    # task queue empties (a lone task's reply is never deferred), and a
+    # reply-flusher thread ships anything still buffered ~1 ms after the
+    # executor moves on, so a long-running next task can never withhold
+    # an earlier task's finished result. 0 disables batching (one
+    # TASK_REPLY frame per task, the pre-r8 behavior).
+    task_done_batch_max: int = 128
+
     # --- scheduling ---
     # Hybrid scheduling policy: prefer local node until its utilization
     # exceeds this, then spread (reference: scheduler_spread_threshold).

@@ -135,6 +135,12 @@ class CoreContext:
         # executor / misc state (must exist before any thread starts)
         self.assigned_tpu_ids: List[int] = []
         self._exec_queue: "queue_mod.Queue" = queue_mod.Queue()
+        # batched task completions (run_executor): per-connection reply
+        # buffer shared with the reply-flusher thread
+        self._reply_buf: Dict[P.Connection, list] = {}
+        self._reply_n = 0
+        self._reply_lock = threading.Lock()
+        self._reply_event = threading.Event()
         self._actor_instance = None
         self._actor_spec: Optional[TaskSpec] = None
         self._cancelled: set = set()
@@ -205,6 +211,19 @@ class CoreContext:
             self.head, self.worker_id, node_idx)
         self.events.start()
 
+        # wire saturation -> cluster event log: a connection's write
+        # queue hitting its bound means the socket isn't draining; the
+        # events page should show it instead of it failing silently
+        # (protocol rate-limits the callback per connection)
+        P.set_backpressure_callback(self._on_wire_backpressure)
+        # the metrics pusher normally starts with the first Metric object;
+        # start it unconditionally so the wire fast-path counters
+        # (frames coalesced, batched completions, zero-copy bytes) reach
+        # the head aggregate from every process
+        from ray_tpu import metrics as _metrics
+
+        _metrics._ensure_pusher()
+
         # submitter
         self._classes: Dict[tuple, _ClassState] = {}
         self._inflight: Dict[TaskID, _InflightTask] = {}
@@ -244,6 +263,12 @@ class CoreContext:
             self._cancelled.add(TaskID(msg[2]))
         elif mt == P.TASK_REPLY:
             self._handle_task_reply(conn, *msg[2:])
+        elif mt == P.TASK_DONE_BATCH:
+            # one frame, many completions (the return-side mirror of
+            # PUSH_TASK_BATCH) — unpickled once, dispatched in execution
+            # order
+            for reply in msg[2]:
+                self._handle_task_reply(conn, *reply)
 
     def _on_head_message(self, conn: P.Connection, msg):
         mt = msg[0]
@@ -274,6 +299,19 @@ class CoreContext:
                              daemon=True).start()
         elif mt == P.KILL_ACTOR:
             os._exit(0)
+
+    def _on_wire_backpressure(self, peer: str, frames: int, nbytes: int):
+        """protocol.set_backpressure_callback target (already off the
+        send hot path, on a short-lived thread)."""
+        if self._shutdown:
+            return
+        try:
+            sev, src, etype, msg, extra = \
+                task_events.wire_backpressure_fields(peer, frames, nbytes)
+            task_events.emit_cluster_event(sev, src, etype, msg,
+                                           extra=extra)
+        except Exception:  # noqa: BLE001 — observability must never wedge
+            pass
 
     def _on_head_close(self, conn):
         if not self._shutdown and not self.is_driver:
@@ -342,10 +380,11 @@ class CoreContext:
             self._contained[oid] = list(sv.contained_refs)
             for r in sv.contained_refs:
                 self.ref_counter.mark_shared(r.id)
-        self.store.put_serialized(oid, sv.frames)
+        total = self.store.put_serialized(oid, sv.frames)
         self.head.send(P.OBJECT_SEALED, oid.binary(), self.node_idx,
                        sv.total_bytes, self.worker_id)
-        self.memory_store.put_plasma_location(oid, self.node_idx)
+        self.memory_store.put_plasma_location(oid, self.node_idx,
+                                              size=total)
         return ObjectRef(oid, self.worker_id)
 
     def _report_evictions_async(self, oids: Sequence[ObjectID]):
@@ -600,11 +639,28 @@ class CoreContext:
         # reclaim; peeking the in-process entry is far cheaper than
         # probing the shm index on every small free
         shm_resident = bool(entry is not None and entry.in_plasma)
+        # Large local copies are reclaimed NOW rather than when the head
+        # gets around to processing our OBJECT_FREE: under a large-put
+        # flood the head lags, bytes_in_use rides the spill threshold,
+        # and the head then spills objects that are already free —
+        # measured collapsing put bandwidth by an order of magnitude.
+        # Size-gated: the native delete costs a ~0.2 ms locked call on
+        # the deployment kernel, which for small objects (negligible
+        # arena pressure) is pure overhead on the free path. Idempotent
+        # with the head's directory-driven delete; a copy pinned by an
+        # in-flight transfer just fails the delete and falls back there.
+        local_delete = (shm_resident and entry.node_idx == self.node_idx
+                        and entry.plasma_size >= (1 << 20))
         self.memory_store.evict(oid)
         if oid in self._pinned:
             self._pinned.discard(oid)
             try:
                 self.store.release(oid)
+            except Exception:
+                pass
+        if local_delete:
+            try:
+                self.store.delete(oid)
             except Exception:
                 pass
         # Small (inline / memory-store) objects: buffer the head
@@ -726,6 +782,7 @@ class CoreContext:
                        sv.total_bytes, self.worker_id)
         e.in_plasma = True
         e.node_idx = self.node_idx
+        e.plasma_size = sv.total_bytes
 
     def _enqueue_spec(self, spec: TaskSpec, arg_ids, holder) -> List[ObjectRef]:
         refs = [ObjectRef(oid, self.worker_id, _register=False)
@@ -1416,10 +1473,25 @@ class CoreContext:
         order.
         """
         pool = None
+        # Batched completions (TASK_DONE_BATCH, the return-side mirror of
+        # PUSH_TASK_BATCH): replies buffer per pushing connection while
+        # MORE tasks are already queued, and flush the moment the queue
+        # empties (or the batch cap is hit) — so a noop flood acks
+        # hundreds of tasks per frame while a lone task's reply is never
+        # deferred. A finished result can never be withheld behind a
+        # long-running next task either: the reply flusher thread sends
+        # anything still buffered ~1 ms after the executor moves on, so
+        # the deferral window is bounded by milliseconds, not by the
+        # next task's duration.
+        batch_cap = get_config().task_done_batch_max
+        if batch_cap:
+            threading.Thread(target=self._reply_flusher_loop,
+                             daemon=True, name="reply-flusher").start()
         while not self._shutdown:
             try:
                 item = self._exec_queue.get(timeout=1.0)
             except queue_mod.Empty:
+                self._flush_task_replies()  # paranoia: nothing lingers
                 continue
             if item is None:
                 break
@@ -1428,6 +1500,7 @@ class CoreContext:
             if (aspec is not None and aspec.max_concurrency > 1
                     and spec.task_type == TaskType.ACTOR_TASK
                     and spec.method_name != "__ray_terminate__"):
+                self._flush_task_replies()
                 if pool is None:
                     import concurrent.futures as cf
 
@@ -1436,29 +1509,93 @@ class CoreContext:
                         thread_name_prefix="actor-exec")
                 pool.submit(self._execute_safe, spec, conn)
             else:
-                if pool is not None and spec.method_name == \
-                        "__ray_terminate__":
-                    # Drain in-flight pooled tasks before _graceful_exit's
-                    # os._exit — otherwise their callers see 'worker died'
-                    # instead of results (same semantics as serial actors,
-                    # where terminate queues behind pending tasks).
-                    pool.shutdown(wait=True)
-                    pool = None
-                self._execute_safe(spec, conn)
+                if spec.method_name == "__ray_terminate__":
+                    # terminate replies inline then os._exit's — anything
+                    # still buffered would be lost with the process
+                    self._flush_task_replies()
+                    if pool is not None:
+                        # Drain in-flight pooled tasks before
+                        # _graceful_exit's os._exit — otherwise their
+                        # callers see 'worker died' instead of results
+                        # (same semantics as serial actors, where
+                        # terminate queues behind pending tasks).
+                        pool.shutdown(wait=True)
+                        pool = None
+                    self._execute_safe(spec, conn)
+                    continue
+                reply = self._execute_guarded(spec, conn)
+                if reply is None:
+                    # inline-replied (actor creation) or crashed — flush
+                    # so nothing waits behind a reply that never comes
+                    self._flush_task_replies()
+                    continue
+                if not batch_cap:
+                    self._send_task_reply(conn, reply)
+                    continue
+                with self._reply_lock:
+                    self._reply_buf.setdefault(conn, []).append(reply)
+                    self._reply_n += 1
+                    n = self._reply_n
+                if n >= batch_cap or self._exec_queue.empty():
+                    self._flush_task_replies()
+                else:
+                    # more tasks queued: defer — the flusher bounds how
+                    # long, in case the next task runs for minutes
+                    self._reply_event.set()
+        self._flush_task_replies()
+
+    def _reply_flusher_loop(self):
+        """Bounds the completion-batching deferral window: the serial
+        executor only defers a reply while more tasks are queued; if the
+        NEXT task runs long, this thread ships the already-finished
+        results ~1 ms later instead of letting them ride out that
+        execution (preserving the pre-batching guarantee that a slow
+        task never withholds an earlier task's finished result)."""
+        while not self._shutdown:
+            if not self._reply_event.wait(0.5):
+                continue
+            time.sleep(0.001)  # let a fast burst accumulate
+            self._flush_task_replies()
+            with self._reply_lock:
+                if not self._reply_n:
+                    self._reply_event.clear()
+
+    def _send_task_reply(self, conn: P.Connection, reply):
+        try:
+            conn.send(P.TASK_REPLY, *reply)
+        except P.ConnectionLost:
+            pass
+
+    def _flush_task_replies(self):
+        """Send buffered completions — one TASK_DONE_BATCH frame per
+        connection (plain TASK_REPLY when only one is pending). Called
+        from the executor and the reply flusher; the buffer swap under
+        the lock makes it safe from both."""
+        with self._reply_lock:
+            if not self._reply_n:
+                return
+            pending = self._reply_buf
+            self._reply_buf = {}
+            self._reply_n = 0
+        for conn, replies in pending.items():
+            try:
+                if len(replies) == 1:
+                    conn.send(P.TASK_REPLY, *replies[0])
+                else:
+                    conn.send(P.TASK_DONE_BATCH, replies)
+                    P.WIRE.task_done_batches += 1
+                    P.WIRE.task_done_batched += len(replies)
+            except P.ConnectionLost:
+                pass  # conn.on_close / lease loss handles the fallout
 
     def _execute_safe(self, spec: TaskSpec, conn: P.Connection):
-        """Execute and reply immediately. Replies are NOT coalesced: the
-        worker's send syscalls run in a separate process from the driver
-        (no GIL contention), and an immediate reply lets the submitter
-        refill this worker's pipeline sooner — measured faster than reply
-        batching, and a long-running next task can never withhold an
-        earlier task's finished result."""
+        """Execute and reply immediately (threaded-actor pool path and
+        terminate; the serial executor loop batches instead). Immediate
+        replies keep concurrent pooled calls independent — a slow pooled
+        task never withholds a finished sibling's result."""
         reply = self._execute_guarded(spec, conn)
         if reply is not None:
-            try:
-                conn.send(P.TASK_REPLY, *reply)
-            except P.ConnectionLost:
-                pass
+            self._send_task_reply(conn, reply)
 
     def _execute_guarded(self, spec: TaskSpec, conn: P.Connection):
         try:
@@ -1555,7 +1692,8 @@ class CoreContext:
                     raise RuntimeError("actor not initialized")
                 if spec.method_name == "__ray_terminate__":
                     conn.send(P.TASK_REPLY, spec.task_id.binary(), "ok",
-                              [("v", serialize(None).frames)], None)
+                              [("v", [bytes(f) for f in
+                                      serialize(None).frames])], None)
                     self._graceful_exit()
                     return None
                 fn = getattr(self._actor_instance, spec.method_name)

@@ -171,6 +171,16 @@ def make_cluster_event(severity: str, source: str, event_type: str,
             event_type, message, dict(extra or {}))
 
 
+def wire_backpressure_fields(peer: str, frames: int, nbytes: int) -> tuple:
+    """(severity, source, type, message, extra) for a wire-saturation
+    event — one source of truth for the two emit paths (a CoreContext
+    sending to the head vs the head appending to its own ring)."""
+    return ("WARNING", "wire", "wire_backpressure",
+            f"write queue to {peer} hit its bound "
+            f"({frames} frames / {nbytes} bytes queued)",
+            {"peer": peer, "frames": frames, "bytes": nbytes})
+
+
 def emit_cluster_event(severity: str, source: str, event_type: str,
                        message: str, *, node_idx: Optional[int] = None,
                        entity_id: str = "", extra: Optional[dict] = None):

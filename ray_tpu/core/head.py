@@ -213,6 +213,18 @@ class Head:
 
     def start(self):
         self.io.start()
+        # Wire-saturation events from this process's connections land in
+        # the ring directly (a CoreContext created later in the same
+        # process re-targets the callback at its head connection — same
+        # ring either way).
+        from .events import wire_backpressure_fields
+
+        def _on_wire_backpressure(peer, frames, nbytes):
+            sev, src, etype, msg, extra = \
+                wire_backpressure_fields(peer, frames, nbytes)
+            self.emit_event(sev, src, etype, msg, extra=extra)
+
+        P.set_backpressure_callback(_on_wire_backpressure)
         # Tail worker log files -> "logs" pubsub channel; drivers mirror
         # them when log_to_driver=True (reference: log_monitor.py:103).
         from .log_monitor import LogMonitor
@@ -2063,7 +2075,13 @@ class Head:
                 rows = [dict(loop=self.io.name, **self.io.stats(),
                              task_events_dropped=self.task_events_dropped,
                              cluster_events_dropped=(
-                                 self.cluster_events_dropped))]
+                                 self.cluster_events_dropped),
+                             # this process's data/return-plane fast-path
+                             # counters (vectored sends, coalesced
+                             # flushes, batched completions, zero-copy
+                             # raw bytes) — cluster-wide per-process
+                             # totals ride the metrics channel instead
+                             wire=P.WIRE.snapshot())]
             elif kind == "cluster_events":
                 # most recent `limit` records, oldest first (the generic
                 # rows[:limit] below then keeps them all)
@@ -2260,6 +2278,12 @@ class Head:
         P.PING: _h_ping,
         P.WORKER_EXIT: _h_worker_exit,
         P.TASK_REPLY: _h_creation_reply,
+        # workers batch completions toward whichever connection pushed
+        # the tasks; nothing head-pushed batches today (creation replies
+        # are inline), but a future head-routed task path must not
+        # silently drop a batched ack
+        P.TASK_DONE_BATCH: lambda self, conn, rid, replies: [
+            self._h_creation_reply(conn, 0, *r) for r in replies],
         P.ACTOR_DEAD: _h_actor_dead,
         P.BORROW_ADD: lambda self, conn, rid, oid, owner, borrower:
             self._forward_to_worker(owner, P.BORROW_ADD, oid, borrower),

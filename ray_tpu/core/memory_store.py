@@ -22,7 +22,8 @@ from .ids import ObjectID
 
 
 class _Entry:
-    __slots__ = ("ready", "value", "is_error", "in_plasma", "node_idx")
+    __slots__ = ("ready", "value", "is_error", "in_plasma", "node_idx",
+                 "plasma_size")
 
     def __init__(self):
         self.ready = False
@@ -30,6 +31,7 @@ class _Entry:
         self.is_error = False
         self.in_plasma = False
         self.node_idx = -1
+        self.plasma_size = 0  # sealed byte count when known (0 = unknown)
 
 
 class _Waiter:
@@ -75,13 +77,19 @@ class MemoryStore:
         for cb in cbs:
             cb()
 
-    def put_plasma_location(self, oid: ObjectID, node_idx: int):
-        """Record that the value lives in node `node_idx`'s shm store."""
+    def put_plasma_location(self, oid: ObjectID, node_idx: int,
+                            size: int = 0):
+        """Record that the value lives in node `node_idx`'s shm store.
+        ``size`` (when the caller knows it — the owner's put path does)
+        lets the free path decide whether a prompt local arena delete is
+        worth its syscall."""
         with self._lock:
             e = self._entries.setdefault(oid, _Entry())
             e.ready = True
             e.in_plasma = True
             e.node_idx = node_idx
+            if size > 0:
+                e.plasma_size = size
             cbs, fired = self._mark_ready_locked(oid)
         for w in fired:
             w.event.set()

@@ -17,6 +17,8 @@ import pickle
 import threading
 from typing import List, Optional, Tuple
 
+import numpy as np
+
 from .ids import ObjectID
 
 _ID_SIZE = 20
@@ -124,6 +126,14 @@ class ShmObjectStore:
     _POPULATE_CHUNK = 64 << 20
 
     def _populate_bg(self):
+        # On kernels without MADV_POPULATE_WRITE this returns immediately
+        # and the arena lazy-faults. An explicit page-touch fallback was
+        # tried and REJECTED: every attaching process faulting 512 MiB
+        # concurrently saturated a small host's cores for ~10 s after
+        # init (measured 25x sync-task-latency inflation during the
+        # storm), while the free-path's prompt local delete already keeps
+        # the large-put cycle on the same warm arena offsets — the
+        # steady-state put path never re-faults.
         advice = getattr(mmap, "MADV_POPULATE_WRITE", 23)
         off, total = 0, None
         while True:
@@ -231,8 +241,13 @@ class ShmObjectStore:
 
     # -- serialized-value interface ------------------------------------------
 
-    def put_serialized(self, object_id: ObjectID, frames: List[bytes]) -> int:
-        """Store pre-serialized frames (header + oob buffers), return bytes."""
+    def put_serialized(self, object_id: ObjectID, frames: List) -> int:
+        """Serialize-into-store put: reserve the shm object from a cheap
+        size pass over the frames, then write the pickle stream and each
+        out-of-band buffer straight into the mapped memoryview — frames
+        are memoryviews of the source object's memory (serialization.py),
+        so every byte moves exactly once, source to arena. Returns the
+        sealed object's byte count."""
         sizes = [len(f) for f in frames]
         meta = pickle.dumps(sizes, protocol=5)
         total = sum(sizes)
@@ -243,8 +258,6 @@ class ShmObjectStore:
                 # numpy's vectorized copy moves ~2x the bytes/s of a Python
                 # memoryview slice assignment — this IS the put-bandwidth
                 # benchmark for large objects.
-                import numpy as np
-
                 np.copyto(np.frombuffer(buf[pos:pos + n], np.uint8),
                           np.frombuffer(f, np.uint8))
             else:

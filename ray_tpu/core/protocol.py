@@ -15,11 +15,13 @@ from __future__ import annotations
 
 import itertools
 import pickle
+import select
 import socket
 import struct
 import threading
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 # 8-byte length prefix: the top bit marks RAW frames, and pickled frames of
 # several GiB (relay fallback of large spilled objects) must still fit.
@@ -82,6 +84,13 @@ TASK_REPLY = 51         # (task_id_bin, status, result_meta, err)  [rpc reply]
 STEAL_BACK = 52
 PUSH_CANCEL = 53        # (task_id_bin, force)
 PUSH_TASK_BATCH = 54    # ([task_specs],) one frame, one pickle, one syscall
+TASK_DONE_BATCH = 55    # ([(task_id_bin, status, result_meta, err)],) the
+#                         return-side mirror of PUSH_TASK_BATCH: a worker
+#                         that finished several tasks between io-loop
+#                         ticks acks them all in ONE frame (small inline
+#                         returns ride along), collapsing the async
+#                         return flood from one pickle + one locked
+#                         syscall per task to a handful per drain
 
 # peer-to-peer object transfer (object_transfer.py; the reference's
 # ObjectManagerService chunked pull, object_manager.proto:61)
@@ -137,6 +146,58 @@ CLUSTER_EVENT = 71      # ([(ts, severity, source, node_idx, entity_id,
 # zero serialization copies.
 _RAW_BIT = 1 << 63
 
+# Max buffers per sendmsg call. POSIX guarantees IOV_MAX >= 16 and Linux
+# gives 1024; staying well below keeps one vectored write's worst-case
+# kernel work bounded even when a drain coalesces many queued frames.
+_IOV_MAX = 64
+
+
+class WireStats:
+    """Process-wide data/return-plane counters (one instance, ``WIRE``).
+
+    Plain int attributes bumped from the send hot paths — a racy lost
+    increment under free-threading is acceptable for observability
+    counters; taking a lock per frame is not. Snapshotted by
+    ``metrics.wire_metrics_snapshot`` (delta push to the head aggregate)
+    and surfaced raw through the head's ``io_loop`` state query.
+    """
+
+    __slots__ = ("frames_sent", "sendmsg_calls", "frames_coalesced",
+                 "coalesced_flushes", "zero_copy_bytes", "bytes_sent",
+                 "task_done_batches", "task_done_batched",
+                 "backpressure_hits")
+
+    def __init__(self):
+        self.frames_sent = 0        # framed messages handed to the wire
+        self.sendmsg_calls = 0      # vectored write syscalls issued
+        self.frames_coalesced = 0   # frames that shared a sendmsg with
+        #                             at least one other frame
+        self.coalesced_flushes = 0  # sendmsg calls carrying > 1 frame
+        self.zero_copy_bytes = 0    # raw-frame bytes sent without an
+        #                             intermediate copy (send_with_raw)
+        self.bytes_sent = 0         # total payload+prefix bytes written
+        self.task_done_batches = 0  # TASK_DONE_BATCH frames sent
+        self.task_done_batched = 0  # completions that rode those frames
+        self.backpressure_hits = 0  # write queue reached its bound
+
+    def snapshot(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+WIRE = WireStats()
+
+# Optional backpressure notifier: ``cb(peer, queued_frames, queued_bytes)``
+# invoked (off the send path, rate-limited per connection) when a
+# connection's write queue hits its bound — the runtime wires this to the
+# cluster event log so wire saturation shows up on the events page
+# instead of failing silently.
+_backpressure_cb: Optional[Callable[[str, int, int], None]] = None
+
+
+def set_backpressure_callback(cb: Optional[Callable[[str, int, int], None]]):
+    global _backpressure_cb
+    _backpressure_cb = cb
+
 
 class ConnectionLost(Exception):
     """Raised by writes/calls on a dead connection. ``conn`` identifies
@@ -167,6 +228,19 @@ class Connection:
         self.sock = sock
         self.peer = peer
         self._wlock = threading.Lock()
+        # Coalescing write queue: senders append their frame's buffer list
+        # (a GIL-atomic deque op — no lock needed to enqueue), then the
+        # sender that wins ``_wlock`` drains EVERYTHING queued in one
+        # vectored write. Uncontended sends find the queue holding only
+        # their own item and flush immediately — the latency path is
+        # unchanged. Items are ``[bufs, nbytes, error, done]``; a sender
+        # blocks on ``_wlock`` until its item is marked done (possibly by
+        # another sender's drain), preserving synchronous ConnectionLost
+        # semantics for every caller.
+        self._wq: deque = deque()
+        self._coalesce_max_bytes = 0   # lazily read from config
+        self._coalesce_max_frames = 0
+        self._backpressure_ts = 0.0
         self._pending: Dict[int, "_Waiter"] = {}
         self._pending_lock = threading.Lock()
         self._rbuf = bytearray()
@@ -185,36 +259,200 @@ class Connection:
             # chunks (e.g. via the transfer plane) instead
             raise ValueError(
                 f"frame too large ({len(payload)} bytes); chunk it")
-        data = _LEN.pack(len(payload)) + payload
-        with self._wlock:
-            if self.closed:
-                raise ConnectionLost(self.peer, conn=self)
-            try:
-                self._send_all(data)
-            except OSError as e:
-                raise ConnectionLost(f"{self.peer}: {e}", conn=self) from e
+        # vectored: the length prefix and payload ship as one iovec — no
+        # prefix+payload concatenation copy
+        self._send_frames((_LEN.pack(len(payload)), payload),
+                          _LEN.size + len(payload))
 
-    def _send_all(self, data: bytes, stall_timeout: float = 60.0):
-        """sendall that survives a non-blocking socket (IOLoop registration
-        sets O_NONBLOCK): under send-buffer pressure ``socket.sendall`` can
-        write a PARTIAL frame then raise EAGAIN — the peer then sees a
-        corrupt stream and the message is silently lost. Loop on partial
-        writes, waiting for writability. Caller holds ``_wlock``.
+    def send_with_raw(self, msg_type: int, *fields, raw) -> None:
+        """Send a pickled header message immediately followed by a RAW
+        frame (bytes/memoryview, no pickling) — atomic with respect to
+        other senders on this connection, so concurrent streams can never
+        interleave between a header and its raw payload. The receiver sees
+        the raw frame as ``(RAW_FRAME, 0, bytes)`` right after the header.
+
+        Zero-copy: the raw buffer rides the iovec straight into sendmsg —
+        a multi-GiB arena slice is never copied into a Python bytes
+        object. Atomicity is structural: the header and raw frame are one
+        write-queue item, and a drain never splits an item across
+        vectored writes."""
+        n = len(raw)
+        if n >= _RAW_BIT:
+            raise ValueError("raw frame too large")
+        header = pickle.dumps((msg_type, 0, *fields), protocol=5)
+        WIRE.zero_copy_bytes += n
+        self._send_frames(
+            (_LEN.pack(len(header)), header, _LEN.pack(n | _RAW_BIT), raw),
+            2 * _LEN.size + len(header) + n)
+
+    def _send_frames(self, bufs: tuple, nbytes: int):
+        """Queue one frame (or an atomic header+raw frame pair) and flush.
+
+        The append is lock-free; whichever sender holds ``_wlock`` drains
+        the whole queue, so under contention frames from concurrent
+        senders coalesce into one sendmsg while each sender still
+        observes its own frame's outcome synchronously."""
+        if self.closed:
+            raise ConnectionLost(self.peer, conn=self)
+        item = [bufs, nbytes, None, False]
+        wq = self._wq
+        wq.append(item)
+        # bound check honors wire_coalesce_max_frames exactly once a
+        # drain has loaded the config; only the first-ever sends on a
+        # connection fall back to the compile-time default
+        if len(wq) >= (self._coalesce_max_frames or 64):
+            self._note_backpressure()
+        with self._wlock:
+            if not item[3]:
+                self._drain_wlocked()
+        err = item[2]
+        if err is not None:
+            raise err
+
+    def _note_backpressure(self):
+        """The wire is saturated — the write queue hit its bound, or a
+        single write sat blocked on an undrained socket for seconds.
+        Count it and (rate-limited, off the hot path via a short-lived
+        thread) tell the cluster event log — wire saturation must be
+        observable, not silent."""
+        WIRE.backpressure_hits += 1
+        now = time.monotonic()
+        if now - self._backpressure_ts < 5.0:
+            return
+        self._backpressure_ts = now
+        cb = _backpressure_cb
+        if cb is None:
+            return
+        # count the write in flight too (the stalled-single-sender case
+        # has an empty queue — the blocked frame IS the backlog)
+        frames = len(self._wq) + 1
+        nbytes = sum(it[1] for it in list(self._wq))
+        threading.Thread(target=cb, args=(self.peer, frames, nbytes),
+                         daemon=True).start()
+
+    def _drain_wlocked(self):
+        """Flush every queued item. Caller holds ``_wlock``.
+
+        Items are grouped into vectored writes bounded by the
+        ``wire_coalesce_*`` knobs and ``_IOV_MAX``; an item's buffers are
+        never split across groups, so a send_with_raw header always
+        shares a write with its raw payload."""
+        wq = self._wq
+        items: List[list] = []
+        while wq:
+            try:
+                items.append(wq.popleft())
+            except IndexError:
+                break
+        if not items:
+            return
+        if self.closed:
+            err = ConnectionLost(self.peer, conn=self)
+            for it in items:
+                it[2] = err
+                it[3] = True
+            return
+        max_bytes = self._coalesce_max_bytes
+        if not max_bytes:
+            from .config import get_config
+
+            cfg = get_config()
+            max_bytes = self._coalesce_max_bytes = \
+                max(1, cfg.wire_coalesce_max_bytes)
+            self._coalesce_max_frames = max(1, cfg.wire_coalesce_max_frames)
+        max_frames = self._coalesce_max_frames
+        try:
+            i, n = 0, len(items)
+            while i < n:
+                bufs: List = list(items[i][0])
+                total = items[i][1]
+                j = i + 1
+                while (j < n and j - i < max_frames
+                       and total + items[j][1] <= max_bytes
+                       and len(bufs) + len(items[j][0]) <= _IOV_MAX):
+                    bufs.extend(items[j][0])
+                    total += items[j][1]
+                    j += 1
+                self._send_all_vectored(bufs)
+                WIRE.frames_sent += j - i
+                WIRE.bytes_sent += total
+                if j - i > 1:
+                    WIRE.frames_coalesced += j - i
+                    WIRE.coalesced_flushes += 1
+                for k in range(i, j):
+                    items[k][3] = True
+                i = j
+        except OSError as e:
+            err = ConnectionLost(f"{self.peer}: {e}", conn=self)
+            err.__cause__ = e
+            for it in items:
+                if not it[3]:
+                    it[2] = err
+                    it[3] = True
+
+    def _send_all_vectored(self, bufs: List, stall_timeout: float = 60.0):
+        """sendmsg that survives a non-blocking socket (IOLoop
+        registration sets O_NONBLOCK) and partial writes ACROSS iovec
+        boundaries: under send-buffer pressure the kernel may accept any
+        byte count — fully-sent buffers are dropped from the head of the
+        vector and the first partially-sent one is resliced. Caller
+        holds ``_wlock``.
 
         The stall timeout counts time with NO progress (reset on every
-        accepted byte). On stall the connection is closed before raising —
-        a partial frame is already on the wire, so any later send on this
-        socket would land mid-frame and permanently desync the peer.
-        """
-        import select as _select
-
-        mv = memoryview(data)
-        deadline = time.monotonic() + stall_timeout
-        while mv:
+        accepted byte). On stall the connection is shut down before
+        raising — a partial frame is already on the wire, so any later
+        send on this socket would land mid-frame and permanently desync
+        the peer."""
+        # Fast path: one direct sendmsg of the caller's buffers — no
+        # memoryview wrapping (measured ~2x the per-call overhead for
+        # small frames). Small control frames virtually always fit the
+        # socket buffer whole, so this is THE hot path; any partial or
+        # blocked write falls through to the resumable slow path.
+        if len(bufs) <= _IOV_MAX:
+            want = sum(b.nbytes if type(b) is memoryview else len(b)
+                       for b in bufs)
             try:
-                n = self.sock.send(mv)
+                sent = self.sock.sendmsg(bufs)
+                WIRE.sendmsg_calls += 1
+                if sent == want:
+                    return
+            except (BlockingIOError, InterruptedError):
+                sent = 0
+        else:
+            sent = 0
+        mvs: List[memoryview] = []
+        for b in bufs:
+            m = memoryview(b)
+            if m.ndim != 1 or m.itemsize != 1:
+                m = m.cast("B")
+            if len(m):  # zero-length iovec (empty raw frame) would make
+                mvs.append(m)  # the progress loop spin on sendmsg()==0
+        idx, total = 0, len(mvs)
+        # skip what the first attempt already put on the wire
+        while sent and idx < total:
+            first = mvs[idx]
+            ln = len(first)
+            if sent >= ln:
+                sent -= ln
+                idx += 1
+            else:
+                mvs[idx] = first[sent:]
+                sent = 0
+        deadline = time.monotonic() + stall_timeout
+        # a write blocked this long is saturation even with a single
+        # sender (queue depth never grows past 1 for synchronous
+        # senders) — surface it before the 60s stall kill does
+        bp_deadline = time.monotonic() + 1.0
+        while idx < total:
+            try:
+                n = self.sock.sendmsg(mvs[idx:idx + _IOV_MAX])
+                WIRE.sendmsg_calls += 1
             except BlockingIOError:
-                if time.monotonic() > deadline:
+                now = time.monotonic()
+                if bp_deadline is not None and now > bp_deadline:
+                    bp_deadline = None
+                    self._note_backpressure()
+                if now > deadline:
                     # A partial frame is on the wire; any later send would
                     # land mid-frame and desync the peer. Kill the stream —
                     # the IO loop sees EOF and runs the full close path
@@ -225,7 +463,7 @@ class Connection:
                         pass
                     raise OSError("send stalled: peer not draining")
                 try:
-                    _select.select([], [self.sock], [], 1.0)
+                    select.select([], [self.sock], [], 1.0)
                 except (OSError, ValueError) as e:
                     # Connection closed concurrently (fd now -1/invalid):
                     # surface as a normal send failure, not a ValueError
@@ -236,27 +474,15 @@ class Connection:
                 continue
             if n:
                 deadline = time.monotonic() + stall_timeout
-            mv = mv[n:]
-
-    def send_with_raw(self, msg_type: int, *fields, raw) -> None:
-        """Send a pickled header message immediately followed by a RAW
-        frame (bytes/memoryview, no pickling) — atomic with respect to
-        other senders on this connection, so concurrent streams can never
-        interleave between a header and its raw payload. The receiver sees
-        the raw frame as ``(RAW_FRAME, 0, bytes)`` right after the header."""
-        n = len(raw)
-        if n >= _RAW_BIT:
-            raise ValueError("raw frame too large")
-        header = pickle.dumps((msg_type, 0, *fields), protocol=5)
-        with self._wlock:
-            if self.closed:
-                raise ConnectionLost(self.peer, conn=self)
-            try:
-                self._send_all(_LEN.pack(len(header)) + header)
-                self._send_all(_LEN.pack(n | _RAW_BIT))
-                self._send_all(raw)
-            except OSError as e:
-                raise ConnectionLost(f"{self.peer}: {e}", conn=self) from e
+            while n and idx < total:
+                first = mvs[idx]
+                ln = len(first)
+                if n >= ln:
+                    n -= ln
+                    idx += 1
+                else:
+                    mvs[idx] = first[n:]
+                    n = 0
 
     def call(self, msg_type: int, *fields, timeout: Optional[float] = None):
         """Send a request and block for its reply; returns reply fields."""
@@ -458,6 +684,16 @@ class IOLoop:
                 elif kind == "listen":
                     try:
                         client, addr = key.fileobj.accept()
+                        if client.family == socket.AF_INET:
+                            # connect_addr sets TCP_NODELAY on the dialing
+                            # side only; without it here every server->
+                            # client reply is at the mercy of Nagle +
+                            # delayed-ack interplay on cross-host links
+                            try:
+                                client.setsockopt(socket.IPPROTO_TCP,
+                                                  socket.TCP_NODELAY, 1)
+                            except OSError:
+                                pass
                         cb(client, addr)
                     except OSError:
                         pass

@@ -21,7 +21,15 @@ except ImportError:  # pragma: no cover
 
 class SerializedValue:
     """A value serialized into frames: frame 0 is the pickle stream, frames
-    1..n are out-of-band buffers (e.g. numpy array payloads)."""
+    1..n are out-of-band buffers (e.g. numpy array payloads).
+
+    Frames may be memoryviews (frame 0 is the BytesIO's exported buffer,
+    out-of-band frames are ``PickleBuffer.raw()`` views of the source
+    object's memory) — nothing is flattened to bytes at serialize time,
+    so a consumer that writes frames straight into a mapped destination
+    (``ShmObjectStore.put_serialized``) moves each byte exactly once.
+    Consumers that embed frames in a pickled message must materialize
+    them (``bytes(f)``) first."""
 
     __slots__ = ("frames", "contained_refs")
 
@@ -55,7 +63,11 @@ def serialize(value: Any) -> SerializedValue:
     sio = io.BytesIO()
     p = _Pickler(sio, protocol=5, buffer_callback=buffers.append)
     p.dump(value)
-    frames = [sio.getvalue()]
+    # getbuffer(), not getvalue(): the pickle stream stays a zero-copy
+    # view of the BytesIO's internal buffer. For in-band-heavy values
+    # (bytes/str payloads) getvalue() was a full second traversal of the
+    # data before the store copy even started.
+    frames = [sio.getbuffer()]
     for b in buffers:
         frames.append(b.raw())
     return SerializedValue(frames, contained_refs)

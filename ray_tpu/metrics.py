@@ -186,6 +186,43 @@ def object_plane_metrics() -> Dict[str, Metric]:
     return _object_plane
 
 
+# ------------------------------------------------------------ wire plane
+
+_WIRE_DESCS = {
+    "frames_sent": "Framed messages written to sockets",
+    "sendmsg_calls": "Vectored write syscalls issued",
+    "frames_coalesced": "Frames that shared a sendmsg with others",
+    "coalesced_flushes": "Vectored writes carrying more than one frame",
+    "zero_copy_bytes": "Raw-frame bytes sent with no intermediate copy",
+    "bytes_sent": "Total bytes written to sockets",
+    "task_done_batches": "TASK_DONE_BATCH completion frames sent",
+    "task_done_batched": "Task completions that rode batched frames",
+    "backpressure_hits": "Times a connection write queue hit its bound",
+}
+_wire_last: Dict[str, int] = {}
+_wire_lock = threading.Lock()
+
+
+def wire_metrics_snapshot() -> List[tuple]:
+    """Delta rows for the process's wire fast-path counters
+    (protocol.WIRE), in the pusher's batch schema — so `frames coalesced /
+    batched completions / zero-copy bytes` aggregate cluster-wide next to
+    the application metrics."""
+    from .core.protocol import WIRE
+
+    snap = WIRE.snapshot()
+    out: List[tuple] = []
+    with _wire_lock:
+        for key, val in snap.items():
+            delta = val - _wire_last.get(key, 0)
+            if delta <= 0:
+                continue
+            _wire_last[key] = val
+            out.append(("counter", f"wire.{key}", _WIRE_DESCS.get(key, ""),
+                        (), (), float(delta)))
+    return out
+
+
 # ------------------------------------------------------------- transport
 
 
@@ -214,6 +251,7 @@ def _push_loop():
         batch: List[tuple] = []
         for m in metrics:
             batch.extend(m._snapshot())
+        batch.extend(wire_metrics_snapshot())
         if not batch:
             continue
         try:
@@ -235,6 +273,7 @@ def flush_now():
     batch: List[tuple] = []
     for m in metrics:
         batch.extend(m._snapshot())
+    batch.extend(wire_metrics_snapshot())
     if batch:
         ctx.head.send(P.METRICS_REPORT, batch)
 

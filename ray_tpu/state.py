@@ -98,7 +98,11 @@ def io_loop_stats() -> List[Dict[str, Any]]:
     events handled, busy seconds, slow-handler episodes, worst
     handler time — plus the head ring-buffer drop counters
     (``task_events_dropped`` / ``cluster_events_dropped``), so silent
-    event-buffer overflow is detectable."""
+    event-buffer overflow is detectable, and the head process's wire
+    fast-path counters (``wire``: vectored sendmsg calls, frames
+    coalesced, batched completions, zero-copy bytes, backpressure
+    hits); cluster-wide per-process wire totals are the ``wire.*``
+    rows in ``metrics_summary()`` instead."""
     return _query("io_loop", 10)
 
 

@@ -1,0 +1,279 @@
+"""Wire fast-path unit tests (protocol.py).
+
+Covers the r8 zero-copy data/return-plane work:
+- feed() slow path: frames split at EVERY byte boundary across feed()
+  calls must reassemble identically to the fast path (incl. RAW frames
+  and a send_with_raw header/raw pair).
+- Vectored sends: send/send_with_raw produce byte-identical streams to
+  the pre-vectored encoding, across unix socketpairs.
+- Coalescing: concurrent senders' frames flush together but a
+  send_with_raw header is NEVER separated from its raw payload, and no
+  frame is ever torn or reordered within a sender.
+- Partial-write handling across iovec boundaries (tiny SO_SNDBUF).
+"""
+
+import pickle
+import socket
+import struct
+import threading
+
+import pytest
+
+from ray_tpu.core import protocol as P
+
+_LEN = struct.Struct("<Q")
+
+
+def _mk_conn(sock=None):
+    if sock is None:
+        sock, _ = socket.socketpair()
+    return P.Connection(sock, peer="test")
+
+
+def _encode(msg_type, *fields, request_id=0):
+    payload = pickle.dumps((msg_type, request_id, *fields), protocol=5)
+    return _LEN.pack(len(payload)) + payload
+
+
+def _encode_raw(data):
+    return _LEN.pack(len(data) | (1 << 63)) + bytes(data)
+
+
+def _normalize(msgs):
+    """RAW payloads may be memoryviews on the fast path — materialize."""
+    out = []
+    for m in msgs:
+        if m[0] == P.RAW_FRAME:
+            out.append((m[0], m[1], bytes(m[2])))
+        else:
+            out.append(tuple(m))
+    return out
+
+
+WIRE_STREAM = (
+    _encode(P.PING, "hello")
+    + _encode(P.KV_PUT, "ns", "key", b"v" * 100, True, request_id=7)
+    # a send_with_raw pair: header then raw frame
+    + _encode(P.OBJ_PULL_CHUNK, b"o" * 20, 4096)
+    + _encode_raw(bytes(range(256)) * 3)
+    + _encode(P.OK, request_id=-7)
+    + _encode_raw(b"")  # empty raw frame edge case
+    + _encode(P.PING, "bye")
+)
+
+EXPECTED = [
+    (P.PING, 0, "hello"),
+    (P.KV_PUT, 7, "ns", "key", b"v" * 100, True),
+    (P.OBJ_PULL_CHUNK, 0, b"o" * 20, 4096),
+    (P.RAW_FRAME, 0, bytes(range(256)) * 3),
+    (P.OK, -7),
+    (P.RAW_FRAME, 0, b""),
+    (P.PING, 0, "bye"),
+]
+
+
+def test_feed_fast_path_whole_stream():
+    conn = _mk_conn()
+    assert _normalize(conn.feed(WIRE_STREAM)) == EXPECTED
+    assert not conn._rbuf
+
+
+def test_feed_slow_path_every_byte_boundary():
+    """Splitting the stream at every byte position across two feeds must
+    reassemble the exact fast-path message list."""
+    for cut in range(1, len(WIRE_STREAM)):
+        conn = _mk_conn()
+        msgs = _normalize(conn.feed(WIRE_STREAM[:cut]))
+        msgs += _normalize(conn.feed(WIRE_STREAM[cut:]))
+        assert msgs == EXPECTED, f"split at {cut} diverged"
+        assert not conn._rbuf, f"split at {cut} left residue"
+
+
+def test_feed_byte_at_a_time():
+    conn = _mk_conn()
+    msgs = []
+    for i in range(len(WIRE_STREAM)):
+        msgs += _normalize(conn.feed(WIRE_STREAM[i:i + 1]))
+    assert msgs == EXPECTED
+    assert not conn._rbuf
+
+
+def _recv_stream(sock, conn, n_expected, timeout=30):
+    sock.settimeout(timeout)
+    msgs = []
+    while len(msgs) < n_expected:
+        data = sock.recv(1 << 20)
+        assert data, "peer closed early"
+        msgs += _normalize(conn.feed(data))
+    return msgs
+
+
+def test_vectored_send_roundtrip():
+    a, b = socket.socketpair()
+    tx, rx = _mk_conn(a), _mk_conn(b)
+    tx.send(P.PING, "x" * 10)
+    tx.send_with_raw(P.OBJ_PULL_CHUNK, b"i" * 20, 0, raw=b"payload" * 100)
+    tx.send_with_raw(P.OBJ_PULL_CHUNK, b"e" * 20, 1, raw=b"")  # empty raw
+    tx.send(P.OK, request_id=-3)
+    msgs = _recv_stream(b, rx, 6)
+    assert msgs == [
+        (P.PING, 0, "x" * 10),
+        (P.OBJ_PULL_CHUNK, 0, b"i" * 20, 0),
+        (P.RAW_FRAME, 0, b"payload" * 100),
+        (P.OBJ_PULL_CHUNK, 0, b"e" * 20, 1),
+        (P.RAW_FRAME, 0, b""),
+        (P.OK, -3),
+    ]
+    a.close()
+    b.close()
+
+
+def test_send_with_raw_memoryview_zero_copy():
+    """A memoryview raw buffer (the arena-slice case) must ship without
+    materialization and count toward the zero-copy byte counter."""
+    a, b = socket.socketpair()
+    tx, rx = _mk_conn(a), _mk_conn(b)
+    blob = memoryview(bytearray(range(256)) * 64)
+    before = P.WIRE.zero_copy_bytes
+    tx.send_with_raw(P.OBJ_PULL_CHUNK, b"z" * 20, 7, raw=blob)
+    assert P.WIRE.zero_copy_bytes - before == len(blob)
+    msgs = _recv_stream(b, rx, 2)
+    assert msgs[1] == (P.RAW_FRAME, 0, bytes(blob))
+    a.close()
+    b.close()
+
+
+def test_partial_writes_across_iovec_boundaries():
+    """A tiny send buffer forces many partial sendmsg completions; the
+    stream must still parse frame-perfect (exercises the resume-mid-iovec
+    logic in _send_all_vectored)."""
+    a, b = socket.socketpair()
+    try:
+        a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+    except OSError:
+        pytest.skip("cannot shrink SO_SNDBUF")
+    a.setblocking(False)  # exercise the EAGAIN/select path too
+    tx, rx = _mk_conn(a), _mk_conn(b)
+    payloads = [bytes([i & 0xFF]) * (3000 + i * 7) for i in range(8)]
+
+    def sender():
+        for i, pl in enumerate(payloads):
+            tx.send_with_raw(P.OBJ_PULL_CHUNK, b"p" * 20, i, raw=pl)
+        tx.send(P.OK)
+
+    t = threading.Thread(target=sender, daemon=True)
+    t.start()
+    msgs = _recv_stream(b, rx, 2 * len(payloads) + 1)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    for i, pl in enumerate(payloads):
+        assert msgs[2 * i] == (P.OBJ_PULL_CHUNK, 0, b"p" * 20, i)
+        assert msgs[2 * i + 1] == (P.RAW_FRAME, 0, pl)
+    assert msgs[-1] == (P.OK, 0)
+    a.close()
+    b.close()
+
+
+def test_concurrent_senders_coalesce_without_interleaving():
+    """Many threads hammering one connection: every frame arrives intact
+    and in per-sender order, and NO send_with_raw header is ever split
+    from its raw payload by another sender's frame."""
+    a, b = socket.socketpair()
+    tx, rx = _mk_conn(a), _mk_conn(b)
+    n_threads, n_msgs = 8, 60
+    coalesced_before = P.WIRE.frames_coalesced
+
+    def sender(tid):
+        for i in range(n_msgs):
+            if i % 3 == 0:
+                raw = bytes([tid]) * (100 + i)
+                tx.send_with_raw(P.OBJ_PULL_CHUNK, bytes([tid]) * 20, i,
+                                 raw=raw)
+            else:
+                tx.send(P.PING, (tid, i))
+
+    threads = [threading.Thread(target=sender, args=(t,), daemon=True)
+               for t in range(n_threads)]
+    total = sum(2 if i % 3 == 0 else 1 for i in range(n_msgs)) * n_threads
+    for t in threads:
+        t.start()
+    msgs = _recv_stream(b, rx, total)
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+    # per-sender arrival order preserved + header/raw adjacency intact
+    seen = {t: 0 for t in range(n_threads)}
+    it = iter(enumerate(msgs))
+    for idx, m in it:
+        if m[0] == P.PING:
+            tid, i = m[2]
+            assert i == seen[tid], f"sender {tid} frames reordered"
+            seen[tid] += 1
+        elif m[0] == P.OBJ_PULL_CHUNK:
+            tid = m[2][0]
+            i = m[3]
+            assert i == seen[tid], f"sender {tid} frames reordered"
+            # the VERY NEXT frame must be this header's raw payload
+            _, nxt = next(it)
+            assert nxt[0] == P.RAW_FRAME, \
+                "header separated from its raw frame"
+            assert bytes(nxt[2]) == bytes([tid]) * (100 + i)
+            seen[tid] += 1
+        else:
+            pytest.fail(f"unexpected frame {m!r}")
+    assert all(v == n_msgs for v in seen.values())
+    # with 8 threads contending, at least some frames must have shared a
+    # vectored flush (the counter is process-wide; other tests only add)
+    assert P.WIRE.frames_coalesced > coalesced_before
+    a.close()
+    b.close()
+
+
+def test_connection_lost_raised_to_each_sender():
+    """Senders whose frames were queued behind a dead socket must all
+    observe ConnectionLost synchronously."""
+    a, b = socket.socketpair()
+    tx = _mk_conn(a)
+    b.close()
+    # first sends may be absorbed by the socket buffer; keep sending
+    with pytest.raises(P.ConnectionLost):
+        for _ in range(1000):
+            tx.send(P.PING, b"x" * 4096)
+    a.close()
+
+
+def test_reply_roundtrip_still_works():
+    """call()/reply() over the vectored path (sanity for the RPC layer)."""
+    a, b = socket.socketpair()
+    tx, rx = _mk_conn(a), _mk_conn(b)
+
+    def responder():
+        b.settimeout(30)
+        got = []
+        while len(got) < 1:
+            got += _normalize(rx.feed(b.recv(1 << 16)))
+        (mt, rid, x) = got[0]
+        rx.reply(rid, x * 2)
+
+    t = threading.Thread(target=responder, daemon=True)
+    t.start()
+
+    # pump replies into tx from a reader thread (no IOLoop here)
+    def pump():
+        a.settimeout(30)
+        while True:
+            try:
+                data = a.recv(1 << 16)
+            except OSError:
+                return
+            if not data:
+                return
+            for m in tx.feed(data):
+                tx.dispatch_reply(m)
+
+    tp = threading.Thread(target=pump, daemon=True)
+    tp.start()
+    assert tx.call(P.KV_GET, 21, timeout=30) == (42,)
+    a.close()
+    b.close()
