@@ -37,6 +37,40 @@ class Config:
     # minimum object size worth splitting across sources at all.
     pull_max_sources: int = 4
     pull_min_stripe_bytes: int = 1 * 1024 * 1024
+    # Cooperative pipelined broadcast (one-to-many distribution of one
+    # object, e.g. model weights pulled by every gang member at step
+    # start). The head's pull planner treats every node it has ALREADY
+    # told to pull an object as an *in-progress location*: until that
+    # pull completes or aborts, later pullers may be pointed at it, and
+    # the in-progress node's TransferServer relays each chunk as soon as
+    # it lands locally (partial-object serving) — forming an implicit
+    # pipelined tree so a cold N-node broadcast moves ~S bytes off the
+    # original holder instead of N x S. ``broadcast_fanout`` bounds how
+    # many concurrent downstream pulls any single source (sealed holder
+    # OR in-progress relay) is assigned before the planner moves on to
+    # the next source; saturating every source falls back to the
+    # least-loaded sealed holder (and fires the rate-limited
+    # ``broadcast_fanout_saturated`` cluster event). The load accounting
+    # is PER OBJECT (one _ObjLoc.serving map each): concurrent
+    # broadcasts of K different objects held by one node may still put
+    # K x fanout streams on that host's uplink — the bound shapes each
+    # object's distribution tree, it is not a host-wide egress limiter.
+    # 0 disables cooperative planning entirely: every puller stripes
+    # across the sealed holder set (the pre-r9 behavior). In-progress
+    # locations are
+    # removed from the directory the moment their pull completes
+    # (promoted to a sealed holder) or fails/aborts (never handed out
+    # again; downstream pulls that already hold the address fail over to
+    # the sealed root set via OBJ_PULL_FAIL / connection loss).
+    broadcast_fanout: int = 2
+    # How long a TransferServer waits for a directory-promised object to
+    # appear locally (the relay's own pull may not have created the
+    # buffer yet) and, once relaying, for each next chunk to arrive,
+    # before failing the remaining range back to the requester
+    # (OBJ_PULL_FAIL -> requester re-pulls from the root holder set).
+    # Only pulls the head marked as relay-served wait at all; a plain
+    # pull from a stale directory entry still fails fast.
+    broadcast_serve_wait_s: float = 10.0
 
     # --- wire fast path ---
     # Small-frame coalescing (protocol.Connection): when several threads

@@ -124,6 +124,20 @@ class _ObjLoc:
     spilled_path: str = ""
     holders: Set[int] = field(default_factory=set)
     waiters: List[Tuple[P.Connection, int]] = field(default_factory=list)
+    # Cooperative broadcast (in-progress locations): nodes the head has
+    # told to pull this object whose pull has not completed yet, mapped
+    # to their transfer address — the planner may point LATER pullers at
+    # them (chunk relay). Entries leave the moment the pull finishes
+    # (promoted to ``holders``) or aborts (never handed out again).
+    inprog: Dict[int, str] = field(default_factory=dict)
+    # Stripe-weighted active downstream pulls per source transfer
+    # address (sealed holders and relays alike): a pull striped across
+    # k roots charges each 1/k — it only takes ~1/k of each uplink —
+    # while a relay-served pull charges its one source a full 1.0. The
+    # planner skips sources at the ``broadcast_fanout`` bound, which is
+    # what bends N simultaneous pullers into a pipelined tree instead
+    # of N streams off one uplink.
+    serving: Dict[str, float] = field(default_factory=dict)
 
 
 class Head:
@@ -165,6 +179,15 @@ class Head:
         # was feasible/available and the hybrid policy decided instead)
         self.locality_hits = 0
         self.locality_misses = 0
+        # cooperative-broadcast planner counters (object_plane state row):
+        # how many pulls were pointed at a sealed root vs an in-progress
+        # relay, and how often every candidate source was already at its
+        # broadcast_fanout bound (the planner then reuses the least-
+        # loaded root and emits the rate-limited saturation event)
+        self.broadcast_root_assignments = 0
+        self.broadcast_relay_assignments = 0
+        self.broadcast_fanout_saturations = 0
+        self._last_saturation_event_ts = 0.0
         # Worker spawner queue (drained by the spawner thread, started in
         # start()): created here so _try_grant can enqueue spawns even on
         # heads that are never start()ed (unit tests drive handlers
@@ -302,7 +325,8 @@ class Head:
         from .object_transfer import TransferServer
 
         self._transfer_server = TransferServer(
-            self.io, self._read_local_object, advertise_ip=ip)
+            self.io, self._read_local_object, advertise_ip=ip,
+            partial_fn=self._partial_local_object)
         return self.tcp_addr
 
     def _read_local_object(self, oid: ObjectID):
@@ -322,6 +346,19 @@ class Head:
             data_v, meta_v = got
             return (data_v, bytes(meta_v),
                     lambda n=node: n.store.release(oid))
+        return None
+
+    def _partial_local_object(self, oid: ObjectID):
+        """TransferServer partial_fn over every in-process node store:
+        an in-progress pull into any head-local arena can relay its
+        chunks to downstream pullers (cooperative broadcast)."""
+        with self._lock:
+            stores = [n.store for n in self.nodes.values()
+                      if n.store is not None and n.alive]
+        for s in stores:
+            part = s.partial(oid)
+            if part is not None:
+                return part
         return None
 
     def _puller_for(self, node: NodeState):
@@ -476,10 +513,18 @@ class Head:
         # object_recovery_manager.h:41). Objects with surviving replicas
         # in the directory just fail over to another holder.
         lost_waiters: List[Tuple[P.Connection, int]] = []
+        # broadcast bookkeeping for the dead host: it can no longer be a
+        # relay (in-progress location) nor serve its assigned downstream
+        # pulls — drop both so the planner stops routing at it (its
+        # in-flight downstream pullers fail over via connection loss)
+        dead_addr = node.transfer_addr if node.is_remote else ""
         with self._lock:
             lost = []
             for oid, loc in list(self.objects.items()):
                 loc.holders.discard(idx)
+                loc.inprog.pop(idx, None)
+                if dead_addr:
+                    loc.serving.pop(dead_addr, None)
                 if loc.node_idx != idx:
                     continue
                 if loc.holders:
@@ -1710,21 +1755,127 @@ class Head:
             return node.transfer_addr or ""
         return self._transfer_server.addr if self._transfer_server else ""
 
-    def _holder_addrs(self, loc: _ObjLoc, exclude_idx: int = -1
-                      ) -> List[str]:
-        """Transfer addresses of every live holder, primary first — the
-        source list a striped pull fans out across (reference: the
-        ObjectDirectory's location set handed to the PullManager)."""
+    def _plan_pull_sources(self, oid: ObjectID, loc: _ObjLoc,
+                           dst_node: NodeState):
+        """Broadcast-aware source planning for ONE brokered pull
+        (reference: PullManager source selection over the
+        ObjectDirectory's location set, pull_manager.cc — extended with
+        the in-progress locations that make a cold one-to-many
+        distribution a pipelined tree). Returns ``(addrs, relay_addrs,
+        max_sources, charged)`` where ``charged`` is [(addr, weight)];
+        the caller MUST pass ``charged`` to ``_finish_pull_assignment``
+        when the pull ends, success or not.
+
+        Policy: prefer sealed holders below their ``broadcast_fanout``
+        load (striped, the PR1 behavior); with every root saturated,
+        hand out ONE in-progress relay under the bound (max_sources=1 so
+        the puller never also stripes the saturated roots — they stay in
+        the list as failover-only candidates); with everything
+        saturated, overload the least-loaded root and note it."""
+        cfg = get_config()
+        fanout = cfg.broadcast_fanout
         with self._lock:
-            addrs = [self._node_transfer_addr(n)
-                     for n in self._holder_nodes(loc, exclude_idx)]
-        return list(dict.fromkeys(a for a in addrs if a))
+            sealed_addrs = list(dict.fromkeys(
+                a for n in self._holder_nodes(loc, exclude_idx=dst_node.idx)
+                for a in (self._node_transfer_addr(n),) if a))
+            if fanout <= 0 or not sealed_addrs or \
+                    loc.size < cfg.pull_min_stripe_bytes:
+                # cooperative planning off / object too small to matter:
+                # the pre-r9 plan (stripe the full sealed holder set)
+                return sealed_addrs, (), 0, []
+            dst_addr = self._node_transfer_addr(dst_node)
+            load = loc.serving
+            relays: Tuple[str, ...] = ()
+            free_roots = sorted(
+                (a for a in sealed_addrs if load.get(a, 0) < fanout),
+                key=lambda a: load.get(a, 0))
+            if free_roots:
+                chosen = free_roots[:max(1, cfg.pull_max_sources)]
+                max_sources = len(chosen)
+                # a k-way stripe takes ~1/k of each root's uplink:
+                # charge fractionally so ordinary multi-holder striped
+                # workloads don't read as broadcast saturation
+                weight = 1.0 / len(chosen)
+                self.broadcast_root_assignments += 1
+            else:
+                free_relays = sorted(
+                    (a for i, a in loc.inprog.items()
+                     if i != dst_node.idx and a and a != dst_addr
+                     and a not in sealed_addrs
+                     and load.get(a, 0) < fanout
+                     and i in self.nodes and self.nodes[i].alive),
+                    key=lambda a: load.get(a, 0))
+                if free_relays:
+                    chosen = [free_relays[0]]
+                    relays = (free_relays[0],)
+                    max_sources = 1
+                    weight = 1.0
+                    self.broadcast_relay_assignments += 1
+                else:
+                    # every source saturated: overload the least-loaded
+                    # root rather than queueing (rate-limited event)
+                    chosen = [min(sealed_addrs,
+                                  key=lambda a: load.get(a, 0))]
+                    max_sources = 1
+                    weight = 1.0
+                    self.broadcast_root_assignments += 1
+                    self._note_fanout_saturated(oid, dst_node.idx)
+            charged = [(a, weight) for a in chosen]
+            for a, w in charged:
+                load[a] = load.get(a, 0) + w
+            if dst_addr:
+                # the requester becomes an in-progress location the
+                # moment its pull is brokered — later planner calls may
+                # relay off it
+                loc.inprog[dst_node.idx] = dst_addr
+            # failover tail: every sealed holder not already primary, so
+            # a dead or aborting relay re-requests from the root set
+            addrs = chosen + [a for a in sealed_addrs if a not in chosen]
+            return addrs, relays, max_sources, charged
+
+    def _finish_pull_assignment(self, oid: ObjectID, dst_idx: int,
+                                charged):
+        """A brokered pull ended (either way): release the source slots
+        it charged and retire the requester's in-progress location.
+        Shares the head lock with the planner, so an aborted/failed
+        puller can never be handed out as a source after its failure is
+        known (directory-staleness-on-abort guarantee)."""
+        if not charged:
+            return  # non-cooperative plan: nothing was registered
+        with self._lock:
+            loc = self.objects.get(oid)
+            if loc is None:
+                return
+            loc.inprog.pop(dst_idx, None)
+            for a, w in charged:
+                n = loc.serving.get(a, 0) - w
+                if n > 1e-9:  # float residue from fractional stripes
+                    loc.serving[a] = n
+                else:
+                    loc.serving.pop(a, None)
+
+    def _note_fanout_saturated(self, oid: ObjectID, dst_idx: int):
+        """Caller holds the lock. Rate-limited: a hot broadcast can hit
+        this once per puller."""
+        self.broadcast_fanout_saturations += 1
+        now = time.monotonic()
+        if now - self._last_saturation_event_ts < 5.0:
+            return
+        self._last_saturation_event_ts = now
+        self.emit_event(
+            "WARNING", "head", "broadcast_fanout_saturated",
+            f"every source for object {oid.hex()[:16]} is at its "
+            f"broadcast_fanout bound ({get_config().broadcast_fanout}); "
+            "assigning the least-loaded sealed holder anyway",
+            extra={"object_id": oid.hex(), "dst_node": dst_idx,
+                   "saturations": self.broadcast_fanout_saturations})
 
     def _p2p_transfer(self, oid: ObjectID, loc: _ObjLoc,
                       dst_node: NodeState) -> bool:
-        """Direct host-to-host pull, striped across every live holder;
-        returns False to fall back to relay."""
-        addrs = self._holder_addrs(loc, exclude_idx=dst_node.idx)
+        """Direct host-to-host pull, sources chosen by the broadcast-
+        aware planner; returns False to fall back to relay."""
+        addrs, relays, max_sources, charged = \
+            self._plan_pull_sources(oid, loc, dst_node)
         if not addrs:
             return False
         try:
@@ -1732,18 +1883,33 @@ class Head:
                 # dst agent pulls straight from the holder hosts
                 reply = dst_node.agent_conn.call(
                     P.PULL_OBJECT, oid.binary(), addrs, loc.size,
-                    timeout=120)
+                    max_sources, list(relays), timeout=120)
                 ok = bool(reply[0])
             else:
                 # dst is a head-local node: the head IS the destination
                 # host — pull straight into the local arena.
                 ok = bool(self._puller_for(dst_node).pull(
-                    oid, addrs, size_hint=loc.size))
+                    oid, addrs, size_hint=loc.size,
+                    max_sources=max_sources, relay_addrs=relays))
             if ok:
                 self._directory_add(oid, dst_node.idx)
             return ok
-        except (P.ConnectionLost, TimeoutError):
-            return False
+        except P.ConnectionLost:
+            return False  # dst/agent died: let the relay path try
+        except TimeoutError:
+            # the agent may STILL be pulling: falling back to the relay
+            # path would both funnel the payload through head memory and
+            # collide with the in-flight pull's unsealed arena entry.
+            # Surface the timeout instead — if the pull lands later the
+            # agent's OBJ_LOCATION_ADD records the holder and the
+            # requester's retry finds it. (The finally below releases
+            # this pull's source charges early in that case; accounting
+            # errs toward optimism for the straggler's tail.)
+            raise
+        finally:
+            # after _directory_add: a finishing puller is continuously
+            # visible (holder by the time its in-progress entry retires)
+            self._finish_pull_assignment(oid, dst_node.idx, charged)
 
     def _h_object_transfer(self, conn, rid, oid_bin, to_node_idx):
         """Copy an object from its node's arena (or spill file) into
@@ -2047,6 +2213,34 @@ class Head:
                     "locality_hits": self.locality_hits,
                     "locality_misses": self.locality_misses,
                     "relay_bytes": self.relay_bytes,
+                    # cooperative-broadcast planner state: live
+                    # in-progress locations + cumulative source-role
+                    # assignment / saturation counters (the per-serve
+                    # root-vs-relay counters ride the metrics channel
+                    # as object_plane.serves{role=...})
+                    "inprog_locations": sum(
+                        len(l.inprog) for l in live),
+                    "broadcast_root_assignments":
+                        self.broadcast_root_assignments,
+                    "broadcast_relay_assignments":
+                        self.broadcast_relay_assignments,
+                    "broadcast_fanout_saturations":
+                        self.broadcast_fanout_saturations,
+                    # the head host's own transfer server, split by
+                    # source role (root = sealed copy, relay = re-served
+                    # in-progress partial); agent-side servers report
+                    # the same split via object_plane.serves metrics
+                    "head_server": ({
+                        "pull_requests":
+                            self._transfer_server.pull_requests,
+                        "served_root": self._transfer_server.served_root,
+                        "served_relay":
+                            self._transfer_server.served_relay,
+                        "bytes_served":
+                            self._transfer_server.bytes_served,
+                        "relay_bytes_served":
+                            self._transfer_server.relay_bytes_served,
+                    } if self._transfer_server is not None else {}),
                 }]
             elif kind == "metrics":
                 # merged client metrics plus the head's own ring-buffer

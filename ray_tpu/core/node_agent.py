@@ -81,7 +81,8 @@ class NodeAgent:
         from .object_transfer import ObjectPuller, TransferServer
 
         self.transfer_server = TransferServer(
-            self.io, self._read_object, advertise_ip=self.node_ip)
+            self.io, self._read_object, advertise_ip=self.node_ip,
+            partial_fn=self.store.partial)
         self.puller = ObjectPuller(self.io, self.store)
         sock = P.connect_addr(head_addr)
         self.head = P.Connection(sock, peer="head")
@@ -181,12 +182,16 @@ class NodeAgent:
             elif mt == P.PULL_OBJECT:
                 # head says: fetch this object straight from peer hosts —
                 # msg carries the directory's holder-address list (or one
-                # addr string) plus the object size for stripe planning
+                # addr string), the object size for stripe planning, and
+                # the broadcast planner's stripe cap + relay markers
                 oid, peers = ObjectID(msg[2]), msg[3]
                 size = msg[4] if len(msg) > 4 else -1
+                max_sources = msg[5] if len(msg) > 5 else 0
+                relays = msg[6] if len(msg) > 6 else ()
                 threading.Thread(
                     target=self._do_pull,
-                    args=(conn, rid, oid, peers, size),
+                    args=(conn, rid, oid, peers, size, max_sources,
+                          relays),
                     daemon=True).start()
             elif mt == P.AGENT_OBJ_FREE:
                 for ob in msg[2]:
@@ -198,9 +203,12 @@ class NodeAgent:
                 conn.reply_error(rid, e)
 
     def _do_pull(self, conn: P.Connection, rid: int, oid: ObjectID,
-                 peers, size: int = -1):
+                 peers, size: int = -1, max_sources: int = 0,
+                 relays=()):
         try:
-            ok = self.puller.pull(oid, peers, size_hint=size)
+            ok = self.puller.pull(oid, peers, size_hint=size,
+                                  max_sources=max_sources,
+                                  relay_addrs=relays)
             if ok and self.node_idx is not None:
                 # report the gained copy so the directory lists this node
                 # as a holder independent of the broker path's bookkeeping

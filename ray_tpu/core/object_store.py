@@ -15,7 +15,9 @@ import mmap
 import os
 import pickle
 import threading
-from typing import List, Optional, Tuple
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -81,6 +83,117 @@ def get_lib():
     return _lib
 
 
+class PartialObject:
+    """Chunk-availability map for an object whose pull is in progress.
+
+    The cooperative-broadcast relay path (object_transfer.py): while an
+    ``ObjectPuller`` streams chunks of an object into a created-but-
+    unsealed arena buffer, this same host's ``TransferServer`` may
+    already be re-serving those chunks to downstream pullers. The puller
+    ``mark()``s each byte range as it lands; a relay ``wait_covered()``s
+    the next range it needs and ``read()``s it out. Chunks may land at
+    arbitrary offsets (multi-source striped upstream pulls), so
+    availability is a set of merged disjoint intervals, not a high-water
+    mark.
+
+    Lifecycle: ``open`` while the pull runs; ``sealed`` once the object
+    seals (relays switch to the normal pinned read path — the native
+    store only evicts sealed *unpinned* objects, and unsealed buffers
+    are never evicted at all, so both phases are eviction-safe);
+    ``aborted`` when the pull fails (the arena view is dropped under the
+    entry lock BEFORE the slot is freed, so an in-flight relay copy can
+    never touch recycled arena memory)."""
+
+    __slots__ = ("oid", "size", "meta", "buf", "lock", "_cond", "_avail",
+                 "state")
+
+    def __init__(self, oid: ObjectID, buf: memoryview, size: int,
+                 meta: bytes):
+        self.oid = oid
+        self.size = size
+        self.meta = meta
+        self.buf = buf  # arena view (data + meta); None once finished
+        self.lock = threading.Lock()
+        self._cond = threading.Condition(self.lock)
+        self._avail: List[List[int]] = []  # sorted disjoint [start, end)
+        self.state = "open"  # open | sealed | aborted
+
+    # -- puller side ---------------------------------------------------
+
+    def mark(self, start: int, end: int):
+        """Record [start, end) as arrived and wake waiting relays."""
+        if end <= start:
+            return
+        with self._cond:
+            iv = self._avail
+            lo = 0
+            while lo < len(iv) and iv[lo][1] < start:
+                lo += 1
+            hi = lo
+            while hi < len(iv) and iv[hi][0] <= end:
+                start = min(start, iv[hi][0])
+                end = max(end, iv[hi][1])
+                hi += 1
+            iv[lo:hi] = [[start, end]]
+            self._cond.notify_all()
+
+    # -- relay side ----------------------------------------------------
+
+    def _covered(self, start: int, end: int) -> bool:
+        # intervals are merged (touching ranges coalesce), so one
+        # interval must span the whole query
+        if end <= start:
+            return True
+        for s, e in self._avail:
+            if s <= start and e >= end:
+                return True
+            if s > start:
+                return False
+        return False
+
+    def wait_covered(self, start: int, end: int,
+                     timeout: float) -> str:
+        """Block until [start, end) is readable; returns ``"ok"`` (read
+        from ``buf``), ``"sealed"`` (read via the store's pinned get),
+        ``"aborted"``, or ``"timeout"``."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if self.state == "sealed":
+                    return "sealed"
+                if self.state == "aborted":
+                    return "aborted"
+                if self._covered(start, min(end, self.size)):
+                    return "ok"
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(remaining):
+                    if self.state == "sealed":
+                        return "sealed"
+                    if self.state == "aborted":
+                        return "aborted"
+                    if self._covered(start, min(end, self.size)):
+                        return "ok"
+                    return "timeout"
+
+    def read(self, start: int, end: int) -> Optional[bytes]:
+        """Copy [start, end) out of the in-progress buffer; None if the
+        pull aborted (buffer gone). The copy happens under the entry
+        lock — finish() blocks on it, so the arena slot outlives every
+        in-flight read."""
+        with self.lock:
+            if self.buf is None:
+                return None
+            return bytes(self.buf[start:end])
+
+    # -- store side ----------------------------------------------------
+
+    def finish(self, sealed: bool):
+        with self._cond:
+            self.state = "sealed" if sealed else "aborted"
+            self.buf = None  # drop the arena view either way: sealed
+            self._cond.notify_all()  # readers re-pin via store.get
+
+
 class ShmObjectStore:
     """One node's shared-memory object store (creator or attacher)."""
 
@@ -101,6 +214,16 @@ class ShmObjectStore:
         # (OBJ_LOCATION_REMOVE) — a stale directory entry would otherwise
         # only be discovered by a pull failing over off it.
         self.on_evict: Optional[callable] = None
+        # In-progress pull availability (cooperative broadcast): oid ->
+        # PartialObject for objects being streamed into unsealed buffers
+        # by this process's ObjectPuller, readable by its TransferServer.
+        # Aborted entries linger as TOMBSTONES (bounded FIFO) so a
+        # relay-marked pull racing the abort fails fast instead of
+        # polling the whole serve-wait budget for a buffer that will
+        # never come back.
+        self._partials: Dict[ObjectID, PartialObject] = {}
+        self._aborted: "deque" = deque()
+        self._partials_lock = threading.Lock()
         # Map the segment for data access (metadata is managed by the C side).
         fd = os.open(f"/dev/shm/{name}", os.O_RDWR)
         try:
@@ -179,6 +302,14 @@ class ShmObjectStore:
     def seal(self, object_id: ObjectID):
         if self._closed:
             return
+        # Finish the partial BEFORE the native seal: an in-flight relay
+        # read drains while the entry is still unsealed (unsealed
+        # objects are never evicted), so no raw-view copy can overlap
+        # the sealed-unpinned window where any thread OR attached
+        # process under memory pressure may evict and recycle the slot.
+        # Relays that see state=="sealed" re-read through the pinned get
+        # path (briefly polling for the native seal to land).
+        self._finish_partial(object_id, sealed=True)
         if get_lib().shm_store_seal(self._h, object_id.binary()) != 0:
             raise KeyError(f"seal failed for {object_id.hex()}")
 
@@ -207,6 +338,11 @@ class ShmObjectStore:
     def delete(self, object_id: ObjectID) -> bool:
         if self._closed:
             return False
+        # An aborted pull (or an explicit free) deletes created-but-
+        # unsealed entries; any relay still serving the partial must stop
+        # touching the arena view BEFORE the slot is freed for reuse —
+        # _finish_partial blocks on in-flight relay reads.
+        self._finish_partial(object_id, sealed=False)
         return get_lib().shm_store_delete(self._h, object_id.binary()) == 0
 
     def evict(self, need: int) -> List[ObjectID]:
@@ -238,6 +374,45 @@ class ShmObjectStore:
         if self._closed:
             return 0
         return get_lib().shm_store_num_objects(self._h)
+
+    # -- in-progress pull availability (cooperative broadcast) ---------------
+
+    def begin_partial(self, object_id: ObjectID, buf: memoryview,
+                      size: int, meta: bytes) -> PartialObject:
+        """Register an in-progress pull's unsealed buffer so this host's
+        TransferServer can relay chunks as they arrive. The entry is
+        finished automatically by ``seal`` (promoted) or ``delete``
+        (aborted) of the same id."""
+        part = PartialObject(object_id, buf, size, bytes(meta))
+        with self._partials_lock:
+            self._partials[object_id] = part
+        return part
+
+    def partial(self, object_id: ObjectID) -> Optional[PartialObject]:
+        with self._partials_lock:
+            return self._partials.get(object_id)
+
+    _ABORT_TOMBSTONES = 256
+
+    def _finish_partial(self, object_id: ObjectID, sealed: bool):
+        with self._partials_lock:
+            part = self._partials.get(object_id)
+            if part is None or part.state == "aborted":
+                return  # unknown, or already a tombstone
+            if sealed:
+                del self._partials[object_id]
+            else:
+                # leave the aborted entry queryable: a relay request
+                # racing the abort gets an immediate "aborted" (->
+                # OBJ_PULL_FAIL -> root failover) instead of burning
+                # the full appear-wait poll. A re-pull's begin_partial
+                # simply overwrites the tombstone.
+                self._aborted.append((object_id, part))
+                if len(self._aborted) > self._ABORT_TOMBSTONES:
+                    old_oid, old_part = self._aborted.popleft()
+                    if self._partials.get(old_oid) is old_part:
+                        del self._partials[old_oid]
+        part.finish(sealed)
 
     # -- serialized-value interface ------------------------------------------
 
@@ -303,6 +478,12 @@ class ShmObjectStore:
     def close(self):
         if self._closed:
             return
+        # wake + detach any relayed in-progress pulls first: a live
+        # partial's arena view would BufferError the munmap below
+        with self._partials_lock:
+            parts, self._partials = list(self._partials.values()), {}
+        for p in parts:
+            p.finish(sealed=False)
         # _lock serializes against an in-flight background populate
         # chunk: munmap under a concurrent madvise would be a
         # use-after-free of the mapping

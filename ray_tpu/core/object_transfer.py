@@ -51,11 +51,17 @@ class TransferServer:
 
     ``read_fn(oid) -> (data_memoryview, meta_bytes, release_cb) | None``
     abstracts over "one agent store" vs "the head's local node stores".
+    ``partial_fn(oid) -> PartialObject | None`` (optional) exposes this
+    host's IN-PROGRESS pulls: with it, a pull the head routed at an
+    in-progress location streams each chunk as soon as the local puller
+    lands it (cooperative pipelined broadcast) instead of failing fast —
+    the serving side of the reference PullManager's chunked re-serving.
     """
 
     def __init__(self, io: P.IOLoop, read_fn: Callable, host: str = "",
-                 advertise_ip: str = ""):
+                 advertise_ip: str = "", partial_fn: Callable = None):
         self._read_fn = read_fn
+        self._partial_fn = partial_fn
         self._listener = P.listen_tcp(host or "0.0.0.0", 0)
         port = self._listener.getsockname()[1]
         ip = advertise_ip or P.local_ip()
@@ -64,6 +70,25 @@ class TransferServer:
         # per-chunk pause, settable by tests/chaos tooling to exercise the
         # mid-pull source-failure path deterministically
         self.throttle_s = 0.0
+        # shared-uplink emulation for benches/tests: all concurrent serves
+        # drain ONE token bucket of this many bytes/s (0 = unlimited) —
+        # unlike throttle_s (per-stream pacing), this models a saturated
+        # host NIC, the regime cooperative broadcast exists for
+        self.egress_limit_bps = 0
+        self._pace_lock = threading.Lock()
+        self._pace_t = 0.0
+        # observability: requests served + egress bytes, split by source
+        # role — "root" streams a sealed local copy, "relay" re-serves an
+        # in-progress pull's chunks as they arrive. Guarded by
+        # _stats_lock: serve threads run concurrently and a bare += on
+        # the byte counters would lose increments (pull_requests alone
+        # is IO-thread-only).
+        self.pull_requests = 0
+        self.served_root = 0
+        self.served_relay = 0
+        self.bytes_served = 0
+        self.relay_bytes_served = 0
+        self._stats_lock = threading.Lock()
         io.add_listener(self._listener, self._on_accept)
 
     def _on_accept(self, sock, _addr):
@@ -76,21 +101,81 @@ class TransferServer:
             return
         start = msg[3] if len(msg) > 3 else 0
         length = msg[4] if len(msg) > 4 else -1
+        # clamp the peer-supplied wait once at the boundary: it is both
+        # the appear-window and the per-chunk relay budget, and a rogue
+        # value must not park serve threads forever
+        wait_s = min(float(msg[5]), 120.0) if len(msg) > 5 else 0.0
+        self.pull_requests += 1  # sole writer: this IO thread
         # Stream on a side thread: a multi-GiB send must not wedge the IO
         # loop that every other connection on this host shares. Concurrent
         # pulls on one connection are safe: each chunk's header+raw pair is
         # sent atomically (send_with_raw), and the puller writes by the
         # (oid, offset) in each header.
         threading.Thread(target=self._serve_pull,
-                         args=(conn, msg[2], start, length),
+                         args=(conn, msg[2], start, length, wait_s),
                          daemon=True).start()
 
-    def _serve_pull(self, conn: P.Connection, oid_bin: bytes,
-                    start: int = 0, length: int = -1):
-        oid = ObjectID(oid_bin)
+    def _pace(self, nbytes: int):
+        """Debit the shared egress bucket; sleeps the calling serve
+        thread until its chunk's slot on the emulated uplink."""
+        if not self.egress_limit_bps:
+            return
+        with self._pace_lock:
+            now = time.monotonic()
+            self._pace_t = max(self._pace_t, now) + \
+                nbytes / self.egress_limit_bps
+            wait = self._pace_t - now
+        if wait > 0:
+            time.sleep(wait)
+
+    def _lookup(self, oid: ObjectID, wait_s: float):
+        """-> (sealed_read | None, partial | None). With ``wait_s`` > 0
+        the directory PROMISED this object is headed here (the local
+        pull is in flight): poll briefly for the buffer to materialize
+        instead of failing fast — a plain pull off a stale directory
+        entry (wait_s == 0) keeps the old immediate-failover behavior."""
         got = self._read_fn(oid)
+        if got is not None:
+            return got, None
+        if wait_s <= 0:
+            # plain pull (e.g. a stale-directory probe): never serve a
+            # partial — chunk-by-chunk dribble behind a slow upstream is
+            # strictly worse than the immediate failover to a live
+            # sealed holder the META -1 reply triggers
+            return None, None
+        part = self._partial_fn(oid) if self._partial_fn else None
+        if part is not None:
+            return None, part
+        deadline = time.monotonic() + wait_s
+        pause = 0.005
+        while time.monotonic() < deadline:
+            time.sleep(pause)
+            # back off: the promised buffer usually appears within tens
+            # of ms, but N waiters polling fast for the full budget
+            # would hammer read_fn/partial_fn's locks (on the head,
+            # that's the global head lock)
+            pause = min(pause * 1.5, 0.1)
+            got = self._read_fn(oid)
+            if got is not None:
+                return got, None
+            part = self._partial_fn(oid) if self._partial_fn else None
+            if part is not None:
+                return None, part
+        return None, None
+
+    def _serve_pull(self, conn: P.Connection, oid_bin: bytes,
+                    start: int = 0, length: int = -1, wait_s: float = 0.0):
+        oid = ObjectID(oid_bin)
         try:
+            got, part = self._lookup(oid, wait_s)
+            if got is None and part is not None and \
+                    part.state != "aborted":
+                self._serve_partial(conn, oid, oid_bin, part, start,
+                                    length, wait_s)
+                return
             if got is None:
+                # absent — or an aborted-pull tombstone: either way the
+                # requester should fail over to another source NOW
                 conn.send(P.OBJ_PULL_META, oid_bin, -1, b"")
                 return
             data, meta, release = got
@@ -100,22 +185,117 @@ class TransferServer:
                 conn.send(P.OBJ_PULL_META, oid_bin, len(data), bytes(meta))
                 end = len(data) if length < 0 else min(start + length,
                                                        len(data))
-                # ~1 MiB chunks so each typically completes within one
-                # receiver recv() buffer, hitting feed()'s zero-copy fast
-                # path (protocol.py). Each chunk is written straight from
-                # the shm arena view — no serialization copies.
-                cs = min(get_config().object_transfer_chunk_bytes, 1 << 20)
-                for off in range(start, end, cs):
-                    if self.throttle_s:
-                        time.sleep(self.throttle_s)
-                    conn.send_with_raw(P.OBJ_PULL_CHUNK, oid_bin, off,
-                                       raw=data[off:min(off + cs, end)])
+                with self._stats_lock:
+                    self.served_root += 1
+                self._stream_range(conn, oid_bin, data, start, end,
+                                   relay=False)
                 # echo the REQUESTED range so the puller can match it even
                 # when length was -1 (open-ended)
                 conn.send(P.OBJ_PULL_DONE, oid_bin, start, length)
+                self._count_serve("root", max(end - start, 0))
             finally:
                 release()
         except P.ConnectionLost:
+            pass
+
+    def _stream_range(self, conn: P.Connection, oid_bin: bytes, data,
+                      start: int, end: int, relay: bool):
+        """Chunk-stream ``data[start:end]`` — ~1 MiB chunks so each
+        typically completes within one receiver recv() buffer, hitting
+        feed()'s zero-copy fast path (protocol.py). Sealed-view slices
+        ship straight from the shm arena — no serialization copies."""
+        cs = min(get_config().object_transfer_chunk_bytes, 1 << 20)
+        for off in range(start, end, cs):
+            self._send_chunk(conn, oid_bin, off, data[off:off + min(
+                cs, end - off)], relay)
+
+    def _send_chunk(self, conn: P.Connection, oid_bin: bytes, off: int,
+                    chunk, relay: bool):
+        """One chunk's egress: throttle, shared-uplink pacing, the
+        atomic header+raw pair, byte accounting — the single sequence
+        every serve path (sealed stream AND relay) must share."""
+        if self.throttle_s:
+            time.sleep(self.throttle_s)
+        self._pace(len(chunk))
+        conn.send_with_raw(P.OBJ_PULL_CHUNK, oid_bin, off, raw=chunk)
+        self._count_bytes(len(chunk), relay)
+
+    def _count_bytes(self, n: int, relay: bool):
+        with self._stats_lock:
+            self.bytes_served += n
+            if relay:
+                self.relay_bytes_served += n
+
+    def _serve_partial(self, conn: P.Connection, oid: ObjectID,
+                       oid_bin: bytes, part, start: int, length: int,
+                       wait_s: float):
+        """Relay an in-progress pull: stream each requested chunk the
+        moment the local puller has it. If the local pull seals mid-
+        relay, finish from the sealed copy (pinned); if it aborts or
+        stalls past the wait budget, hand the UNDELIVERED tail back with
+        OBJ_PULL_FAIL so the requester re-pulls it from the root holder
+        set (relay-aware failover)."""
+        size = part.size
+        conn.send(P.OBJ_PULL_META, oid_bin, size, part.meta)
+        end = size if length < 0 else min(start + length, size)
+        with self._stats_lock:
+            self.served_relay += 1
+        cs = min(get_config().object_transfer_chunk_bytes, 1 << 20)
+        budget = max(wait_s, 1.0)
+        off = start
+        sealed = False
+        while off < end:
+            n = min(cs, end - off)
+            status = part.wait_covered(off, off + n, budget)
+            if status == "sealed":
+                sealed = True
+                break
+            chunk = part.read(off, off + n) if status == "ok" else None
+            if chunk is None:
+                if part.state == "sealed":
+                    # seal landed between wait_covered and read (finish
+                    # dropped the buffer): the object is HERE, whole —
+                    # switch to the sealed copy, don't fail the range
+                    sealed = True
+                    break
+                # aborted or stalled past the wait budget
+                conn.send(P.OBJ_PULL_FAIL, oid_bin, off)
+                self._count_serve("relay", max(off - start, 0))
+                return
+            self._send_chunk(conn, oid_bin, off, chunk, relay=True)
+            off += n
+        if sealed and off < end:
+            # the partial is finished just BEFORE the native seal lands
+            # (object_store.seal's eviction-safe ordering), so the
+            # pinned read can trail the sealed flag by a moment — poll
+            # briefly before declaring the copy gone (evicted)
+            got = self._read_fn(oid)
+            deadline = time.monotonic() + 2.0
+            while got is None and time.monotonic() < deadline:
+                time.sleep(0.002)
+                got = self._read_fn(oid)
+            if got is None:  # sealed copy evicted before we switched over
+                conn.send(P.OBJ_PULL_FAIL, oid_bin, off)
+                self._count_serve("relay", max(off - start, 0))
+                return
+            data, _meta, release = got
+            try:
+                self._stream_range(conn, oid_bin, data, off, end,
+                                   relay=True)
+            finally:
+                release()
+        conn.send(P.OBJ_PULL_DONE, oid_bin, start, length)
+        self._count_serve("relay", max(end - start, 0))
+
+    def _count_serve(self, role: str, nbytes: int):
+        try:
+            from ray_tpu.metrics import object_plane_metrics
+
+            m = object_plane_metrics()
+            tags = {"role": role}
+            m["serves"].inc(1, tags)
+            m["serve_bytes"].inc(nbytes, tags)
+        except Exception:  # noqa: BLE001 — metrics must never fail a serve
             pass
 
     def close(self):
@@ -164,7 +344,7 @@ class _Range:
 class _PullState:
     __slots__ = ("buf", "done", "error", "buf_lock", "size", "ranges",
                  "conns", "addrs", "failed_addrs", "started",
-                 "planned_sources")
+                 "planned_sources", "max_sources", "relay_addrs", "part")
 
     def __init__(self):
         self.buf = None
@@ -177,6 +357,9 @@ class _PullState:
         self.addrs: List[str] = []                # every candidate source
         self.failed_addrs: set = set()
         self.started = False
+        self.max_sources = 0       # planner-imposed stripe cap (0 = config)
+        self.relay_addrs: frozenset = frozenset()  # in-progress sources
+        self.part = None  # local chunk-availability map (relay serving)
         # serializes chunk writes + range bookkeeping against the abort
         # path's buf=None + arena delete and against source reassignment —
         # a copy into a freed (and possibly reallocated) arena slot would
@@ -223,12 +406,20 @@ class ObjectPuller:
 
     def pull(self, oid: ObjectID,
              peer_addr: Union[str, Sequence[str]],
-             timeout: float = 120.0, size_hint: int = -1) -> bool:
+             timeout: float = 120.0, size_hint: int = -1,
+             max_sources: int = 0,
+             relay_addrs: Sequence[str] = ()) -> bool:
         """Blocking: fetch ``oid`` into the local store.
 
         ``peer_addr`` is one transfer address or the holder list from the
         object directory; ``size_hint`` (the directory's recorded size)
-        enables striping without a metadata round trip.
+        enables striping without a metadata round trip. ``max_sources``
+        caps the stripe width below ``pull_max_sources`` (the head's
+        broadcast planner sets 1 so a relay-served pull never also
+        stripes the root set — later addrs stay failover-only);
+        ``relay_addrs`` marks which candidates are IN-PROGRESS pullers:
+        their OBJ_PULLs carry the broadcast serve-wait budget so the
+        relay subscribes us to chunk arrival instead of failing fast.
         """
         if self._store.contains(oid):
             return True
@@ -248,6 +439,8 @@ class ObjectPuller:
                 leader = False
             else:
                 st = self._pending[oid] = _PullState()
+                st.max_sources = max_sources
+                st.relay_addrs = frozenset(relay_addrs)
                 leader = True
         if not leader:  # another thread is already pulling this object
             st.done.wait(timeout)
@@ -260,30 +453,44 @@ class ObjectPuller:
         except P.ConnectionLost as e:
             st.error = str(e)
         finally:
-            with self._lock:
-                self._pending.pop(oid, None)
             if st.error is not None and not self._store.contains(oid):
                 # never leave a created-but-unsealed entry behind: it would
                 # poison every retry (create fails on existing ids) while
                 # readers block forever on an object that never seals.
                 # buf_lock: an in-flight chunk copy must finish before the
-                # arena slot is freed.
+                # arena slot is freed. Delete BEFORE dropping the _pending
+                # entry: while we hold it no retry can become leader, so
+                # this delete can never land on a retry's fresh buffer
+                # (the reclaim in the META handler would otherwise race).
                 with st.buf_lock:
                     st.buf = None
                     self._store.delete(oid)
+            with self._lock:
+                self._pending.pop(oid, None)
             st.done.set()
         ok = st.error is None
         if ok:
             self._record_pull(st, time.monotonic() - t0)
         return ok
 
+    def _send_pull_req(self, conn: P.Connection, st: _PullState,
+                       oid: ObjectID, start: int, length: int, addr: str):
+        """OBJ_PULL with the serve-wait budget when the target is an
+        in-progress relay (it subscribes us to chunk arrival) and the
+        old fail-fast zero for sealed holders."""
+        wait_s = get_config().broadcast_serve_wait_s \
+            if addr in st.relay_addrs else 0.0
+        conn.send(P.OBJ_PULL, oid.binary(), start, length, wait_s)
+
     def _start_pull(self, st: _PullState, oid: ObjectID,
                     addrs: List[str], size_hint: int):
         cfg = get_config()
         st.addrs = list(addrs)
+        width = min(st.max_sources or cfg.pull_max_sources,
+                    cfg.pull_max_sources)
         conns: List[Tuple[P.Connection, str]] = []
         for a in addrs:  # backfill past unreachable holders
-            if len(conns) >= max(1, cfg.pull_max_sources):
+            if len(conns) >= max(1, width):
                 break
             try:
                 conns.append((self._peer(a), a))
@@ -317,9 +524,9 @@ class ObjectPuller:
             st.planned_sources = len({r.addr for r in st.ranges})
             plan = [(c, a, r) for r in st.ranges
                     for c, a in conns if a == r.addr]
-        for conn, _addr, r in plan:
+        for conn, addr, r in plan:
             try:
-                conn.send(P.OBJ_PULL, oid.binary(), r.start, r.length)
+                self._send_pull_req(conn, st, oid, r.start, r.length, addr)
             except P.ConnectionLost:
                 # the IO loop may not have noticed the death yet — run the
                 # failover path ourselves (idempotent with on_close)
@@ -393,6 +600,13 @@ class ObjectPuller:
                     st.buf = None
                     self._store.seal(oid)
                     st.done.set()
+                    return
+                # publish the unsealed buffer's availability map so this
+                # host's TransferServer can relay chunks as they land
+                # (cooperative broadcast); seal/delete of the id finish
+                # the entry automatically
+                st.part = self._store.begin_partial(oid, st.buf, size,
+                                                    bytes(meta))
         elif mt == P.OBJ_PULL_CHUNK:
             self._expect[conn] = (ObjectID(msg[2]), msg[3])
         elif mt == P.RAW_FRAME:
@@ -436,10 +650,23 @@ class ObjectPuller:
                             if off == r.start + r.received:
                                 r.received += n
                             break
+                    if st.part is not None:
+                        # AFTER the copy: a relay must never stream bytes
+                        # the arena doesn't hold yet
+                        st.part.mark(off, off + n)
             if addr is not None:
                 # sole writer is this IO thread — plain dict update is safe
                 self.bytes_by_source[addr] = \
                     self.bytes_by_source.get(addr, 0) + n
+        elif mt == P.OBJ_PULL_FAIL:
+            # a relay could not complete our range (its own pull aborted
+            # or stalled): fail over THIS object's ranges on this
+            # connection only — the connection is healthy, and what
+            # already arrived stays credited; the undelivered tail is
+            # re-requested from the remaining candidates (the root set)
+            oid = ObjectID(msg[2])
+            self._handle_conn_failure(conn, reason="relay source aborted",
+                                      only_oid=oid)
         elif mt == P.OBJ_PULL_DONE:
             oid = ObjectID(msg[2])
             start = msg[3] if len(msg) > 3 else 0
@@ -547,7 +774,8 @@ class ObjectPuller:
                     plan.append((resume, remaining))
             try:
                 for resume, remaining in plan:
-                    tconn.send(P.OBJ_PULL, oid.binary(), resume, remaining)
+                    self._send_pull_req(tconn, st, oid, resume, remaining,
+                                        taddr)
             except P.ConnectionLost:
                 self._handle_conn_failure(tconn)
 

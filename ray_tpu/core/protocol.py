@@ -94,13 +94,23 @@ TASK_DONE_BATCH = 55    # ([(task_id_bin, status, result_meta, err)],) the
 
 # peer-to-peer object transfer (object_transfer.py; the reference's
 # ObjectManagerService chunked pull, object_manager.proto:61)
-PULL_OBJECT = 56        # head->agent: (oid_bin, [holder_addrs], size) -> ok
-#                         (a single addr string is accepted for compat)
-OBJ_PULL = 57           # puller->server, one-way: (oid_bin, start, length);
-#                         length -1 = "through end of object". Disjoint
-#                         ranges of one object may be requested from
-#                         different holders concurrently (striped pull,
-#                         the reference's PullManager chunk fan-out).
+PULL_OBJECT = 56        # head->agent: (oid_bin, [holder_addrs], size[,
+#                         max_sources, [relay_addrs]]) -> ok (a single
+#                         addr string is accepted for compat).
+#                         max_sources caps the stripe width (0 = config
+#                         default); relay_addrs marks which of the
+#                         holder addrs are IN-PROGRESS pullers serving
+#                         partial objects (cooperative broadcast) — the
+#                         puller waits for those instead of failing fast
+OBJ_PULL = 57           # puller->server, one-way: (oid_bin, start,
+#                         length[, wait_s]); length -1 = "through end of
+#                         object". Disjoint ranges of one object may be
+#                         requested from different holders concurrently
+#                         (striped pull, the reference's PullManager
+#                         chunk fan-out). wait_s > 0: the server may
+#                         serve a PARTIALLY present object, waiting up
+#                         to wait_s for it to appear / for each next
+#                         chunk to land (relay of an in-progress pull)
 OBJ_PULL_CHUNK = 58     # server->puller header: (oid_bin, offset);
 #                         the chunk bytes follow as ONE raw frame
 OBJ_PULL_DONE = 59      # server->puller: (oid_bin, start, length) — the
@@ -139,6 +149,14 @@ CLUSTER_EVENT = 71      # ([(ts, severity, source, node_idx, entity_id,
                         # the GCS cluster event log behind
                         # `ray list cluster-events`); one-way from any
                         # process, mirroring the task-event channel
+OBJ_PULL_FAIL = 72      # server->puller: (oid_bin, offset) — the server
+                        # cannot complete the requested range past
+                        # `offset` (its own in-progress pull aborted, or
+                        # a promised object never materialized); the
+                        # puller fails over ONLY this object's ranges on
+                        # this connection to the remaining candidate
+                        # sources (the root holder set), crediting what
+                        # already arrived
 
 # High bit of the length prefix marks a RAW frame: the payload is
 # unpickled bytes (bulk data follows its pickled header message). Sending

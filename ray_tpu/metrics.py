@@ -182,6 +182,20 @@ def object_plane_metrics() -> Dict[str, Metric]:
                         "object_plane.pull_latency_s",
                         "End-to-end object pull latency (seconds)",
                         boundaries=PULL_LATENCY_BOUNDARIES),
+                    # serving side (TransferServer): role=root streams a
+                    # sealed local copy, role=relay re-serves an
+                    # in-progress pull chunk-by-chunk (cooperative
+                    # broadcast tree)
+                    "serves": Counter(
+                        "object_plane.serves",
+                        "OBJ_PULL ranges served to downstream pullers, "
+                        "by source role",
+                        tag_keys=("role",)),
+                    "serve_bytes": Counter(
+                        "object_plane.serve_bytes",
+                        "Bytes streamed out of local arenas to "
+                        "downstream pullers, by source role",
+                        tag_keys=("role",)),
                 }
     return _object_plane
 

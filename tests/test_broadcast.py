@@ -1,0 +1,931 @@
+"""Cooperative pipelined broadcast (PR r9).
+
+Four layers, bottom-up:
+  - PartialObject availability map: interval merge / coverage semantics
+    for every byte split and both arrival orders (serve-order
+    equivalence of the chunk bitmap).
+  - Partial-object relay serving: real TransferServers + ObjectPullers
+    on one IO loop — a downstream puller streams an object THROUGH a
+    peer whose own pull is still in progress, including the
+    subscribe-to-arrival window, the abort -> OBJ_PULL_FAIL -> root
+    failover path, and freed-slot safety.
+  - Head fan-out planner: in-progress locations, per-source
+    broadcast_fanout bounds, saturation fallback, and
+    directory-staleness-on-abort (an aborted in-progress location is
+    never handed out again).
+  - Real cluster: concurrent cold pulls by remote agents form a relay
+    tree (per-holder OBJ_PULL counts bounded by broadcast_fanout), a
+    killed mid-tree relay fails over to the root, and
+    collective.broadcast rides the cooperative path with zero head
+    relay bytes.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import protocol as P
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import PartialObject, ShmObjectStore
+from ray_tpu.core.object_transfer import ObjectPuller, TransferServer
+from ray_tpu.core.resources import NodeResources, ResourceSet
+
+ARENA = 64 * 1024 * 1024
+
+
+def _payload(nbytes, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, nbytes, dtype=np.uint8).tobytes()
+
+
+def _fetch_bytes(store, oid):
+    d, m = store.get(oid)
+    out = bytes(d)
+    del d, m
+    store.release(oid)
+    return out
+
+
+# ------------------------------------------ availability-map semantics
+
+
+def test_partial_every_byte_split_both_orders():
+    """Marking [0,k) and [k,N) in either order must converge to full
+    coverage, with no split point covered early — the chunk-bitmap
+    serve-order equivalence the relay loop relies on."""
+    N = 64
+    for k in range(N + 1):
+        for order in ((0, 1), (1, 0)):
+            part = PartialObject(ObjectID.from_random(),
+                                 memoryview(bytearray(N)), N, b"")
+            pieces = [(0, k), (k, N)]
+            first = pieces[order[0]]
+            part.mark(*first)
+            if 0 < k < N:
+                assert not part._covered(0, N), (k, order)
+                assert part._covered(*first) or first[0] == first[1]
+            part.mark(*pieces[order[1]])
+            assert part._covered(0, N), (k, order)
+            assert len(part._avail) == 1  # touching ranges coalesced
+
+
+def test_partial_out_of_order_chunks_and_queries():
+    part = PartialObject(ObjectID.from_random(),
+                         memoryview(bytearray(100)), 100, b"")
+    part.mark(40, 60)
+    part.mark(0, 20)
+    assert part._covered(45, 55) and part._covered(0, 20)
+    assert not part._covered(10, 45)
+    part.mark(20, 40)  # bridges the gap
+    assert part._covered(0, 60) and len(part._avail) == 1
+    assert not part._covered(0, 61)
+    assert part.wait_covered(0, 60, timeout=0.01) == "ok"
+    assert part.wait_covered(0, 100, timeout=0.01) == "timeout"
+
+
+def test_partial_wait_wakes_on_mark_seal_abort():
+    def waiter(part, rng, out):
+        out.append(part.wait_covered(*rng, timeout=10.0))
+
+    part = PartialObject(ObjectID.from_random(),
+                         memoryview(bytearray(10)), 10, b"")
+    out = []
+    t = threading.Thread(target=waiter, args=(part, (0, 10), out))
+    t.start()
+    part.mark(0, 10)
+    t.join(5)
+    assert out == ["ok"]
+
+    for final, expect in ((True, "sealed"), (False, "aborted")):
+        part = PartialObject(ObjectID.from_random(),
+                             memoryview(bytearray(10)), 10, b"")
+        out = []
+        t = threading.Thread(target=waiter, args=(part, (0, 10), out))
+        t.start()
+        time.sleep(0.05)
+        part.finish(sealed=final)
+        t.join(5)
+        assert out == [expect]
+        assert part.read(0, 5) is None  # arena view dropped either way
+
+
+def test_store_lifecycle_finishes_partial():
+    """seal() promotes, delete() aborts — the puller never has to
+    remember to finish the entry on its many exit paths."""
+    store = ShmObjectStore(f"rtpu_tb_{ObjectID.from_random().hex()[:8]}",
+                           8 * 1024 * 1024, create=True)
+    try:
+        oid = ObjectID.from_random()
+        buf = store.create(oid, 1024)
+        part = store.begin_partial(oid, buf, 1024, b"")
+        assert store.partial(oid) is part
+        buf[:] = b"x" * 1024
+        part.mark(0, 1024)
+        store.seal(oid)
+        assert part.state == "sealed" and store.partial(oid) is None
+
+        oid2 = ObjectID.from_random()
+        buf2 = store.create(oid2, 1024)
+        part2 = store.begin_partial(oid2, buf2, 1024, b"")
+        del buf2
+        store.delete(oid2)
+        assert part2.state == "aborted"
+        # aborted entries linger as queryable tombstones (fail-fast for
+        # relay pulls racing the abort); a re-pull overwrites them
+        assert store.partial(oid2) is part2
+        buf3 = store.create(oid2, 1024)
+        part3 = store.begin_partial(oid2, buf3, 1024, b"")
+        del buf3
+        assert store.partial(oid2) is part3
+    finally:
+        store.close()
+
+
+# ------------------------------------------------ relay serving (real IO)
+
+
+@pytest.fixture
+def xfer():
+    """N (store, server, puller) hosts on one IO loop — each can seed,
+    serve (sealed or partial), and pull, like real agent processes."""
+    io = P.IOLoop("test-bcast-io")
+    io.start()
+    hosts = []
+
+    def make_host():
+        s = ShmObjectStore(f"rtpu_tb_{ObjectID.from_random().hex()[:8]}",
+                           ARENA, create=True)
+
+        def read(oid, _s=s):
+            got = _s.get(oid)
+            if got is None:
+                return None
+            d, m = got
+            return d, bytes(m), (lambda: _s.release(oid))
+
+        srv = TransferServer(io, read, advertise_ip="127.0.0.1",
+                             partial_fn=s.partial)
+        puller = ObjectPuller(io, s)
+        hosts.append((s, srv, puller))
+        return s, srv, puller
+
+    yield make_host
+    for s, srv, puller in hosts:
+        puller.close()
+        srv.close()
+        s.close()
+    io.stop()
+
+
+def _seed(store, oid, payload):
+    buf = store.create(oid, len(payload))
+    buf[:] = payload
+    store.seal(oid)
+
+
+def _wait_for(pred, timeout=30, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_relay_serves_in_progress_pull(xfer):
+    """C pulls through B while B is still pulling from root A; the root
+    sees exactly ONE OBJ_PULL and C's bytes are intact."""
+    (sa, srv_a, _pa) = xfer()
+    (sb, srv_b, pull_b) = xfer()
+    (sc, _srv_c, pull_c) = xfer()
+    oid, payload = ObjectID.from_random(), _payload(8 * 1024 * 1024, seed=1)
+    _seed(sa, oid, payload)
+    srv_a.throttle_s = 0.02  # 8 chunks -> B's pull takes >= 160 ms
+
+    res = {}
+    tb = threading.Thread(target=lambda: res.setdefault(
+        "b", pull_b.pull(oid, [srv_a.addr], timeout=60,
+                         size_hint=len(payload))))
+    tb.start()
+    _wait_for(lambda: sb.partial(oid) is not None or sb.contains(oid),
+              msg="B's pull to begin")
+    ok_c = pull_c.pull(oid, [srv_b.addr], timeout=60,
+                       size_hint=len(payload),
+                       relay_addrs=[srv_b.addr])
+    tb.join(60)
+    assert res.get("b") is True and ok_c is True
+    assert _fetch_bytes(sc, oid) == payload
+    assert _fetch_bytes(sb, oid) == payload
+    assert srv_a.pull_requests == 1          # root served B only
+    assert srv_b.served_relay >= 1           # C rode the partial
+    assert srv_b.relay_bytes_served + srv_b.bytes_served >= len(payload)
+
+
+def test_relay_waits_for_promised_object(xfer):
+    """The directory can point C at B BEFORE B's own pull created the
+    buffer — B's server subscribes C instead of failing fast."""
+    (sa, srv_a, _pa) = xfer()
+    (sb, srv_b, pull_b) = xfer()
+    (sc, _srv_c, pull_c) = xfer()
+    oid, payload = ObjectID.from_random(), _payload(2 * 1024 * 1024, seed=2)
+    _seed(sa, oid, payload)
+
+    res = {}
+    tc = threading.Thread(target=lambda: res.setdefault(
+        "c", pull_c.pull(oid, [srv_b.addr], timeout=60,
+                         size_hint=len(payload),
+                         relay_addrs=[srv_b.addr])))
+    tc.start()
+    time.sleep(0.15)  # C's OBJ_PULL reaches B with nothing there yet
+    assert pull_b.pull(oid, [srv_a.addr], timeout=60,
+                       size_hint=len(payload))
+    tc.join(60)
+    assert res.get("c") is True
+    assert _fetch_bytes(sc, oid) == payload
+    assert srv_b.served_relay + srv_b.served_root >= 1
+
+
+def test_relay_chain_depth_two(xfer):
+    """A -> B -> C -> D: every hop relays the previous hop's partial."""
+    (sa, srv_a, _pa) = xfer()
+    (sb, srv_b, pull_b) = xfer()
+    (sc, srv_c, pull_c) = xfer()
+    (sd, _srv_d, pull_d) = xfer()
+    oid, payload = ObjectID.from_random(), _payload(8 * 1024 * 1024, seed=3)
+    _seed(sa, oid, payload)
+    srv_a.throttle_s = 0.02
+
+    res = {}
+    threads = [
+        threading.Thread(target=lambda: res.setdefault(
+            "b", pull_b.pull(oid, [srv_a.addr], timeout=60,
+                             size_hint=len(payload)))),
+        threading.Thread(target=lambda: res.setdefault(
+            "c", pull_c.pull(oid, [srv_b.addr, srv_a.addr], timeout=60,
+                             size_hint=len(payload), max_sources=1,
+                             relay_addrs=[srv_b.addr]))),
+        threading.Thread(target=lambda: res.setdefault(
+            "d", pull_d.pull(oid, [srv_c.addr, srv_a.addr], timeout=60,
+                             size_hint=len(payload), max_sources=1,
+                             relay_addrs=[srv_c.addr]))),
+    ]
+    for t in threads:
+        t.start()
+        time.sleep(0.05)  # let each hop's pull register before the next
+    for t in threads:
+        t.join(90)
+    assert res == {"b": True, "c": True, "d": True}
+    for s in (sb, sc, sd):
+        assert _fetch_bytes(s, oid) == payload
+    assert srv_a.pull_requests == 1  # only B ever touched the root
+
+
+def test_mid_tree_relay_death_fails_over_to_root(xfer):
+    """Kill relay B's own upstream pull while C rides it: B's abort
+    frees only the ranges C never got (OBJ_PULL_FAIL), and C re-pulls
+    the tail from root A — the regression test for directory staleness
+    + relay-aware failover."""
+    (sa, srv_a, _pa) = xfer()
+    (sb, sb_srv, pull_b) = xfer()
+    (sc, _sc_srv, pull_c) = xfer()
+    oid, payload = ObjectID.from_random(), _payload(8 * 1024 * 1024, seed=4)
+    _seed(sa, oid, payload)
+    srv_a.throttle_s = 0.05  # B's pull: 8 chunks -> >= 400 ms
+
+    res = {}
+    tb = threading.Thread(target=lambda: res.setdefault(
+        "b", pull_b.pull(oid, [srv_a.addr], timeout=60,
+                         size_hint=len(payload))))
+    tb.start()
+    _wait_for(lambda: pull_b.bytes_by_source.get(srv_a.addr, 0) > 0,
+              msg="B to receive some bytes")
+    tc = threading.Thread(target=lambda: res.setdefault(
+        "c", pull_c.pull(oid, [sb_srv.addr, srv_a.addr], timeout=60,
+                         size_hint=len(payload), max_sources=1,
+                         relay_addrs=[sb_srv.addr])))
+    tc.start()
+    _wait_for(lambda: pull_c.bytes_by_source.get(sb_srv.addr, 0) > 0,
+              msg="C to receive relayed bytes")
+    # kill B's upstream: its pull fails, aborts, deletes its buffer
+    conn = pull_b._conns.get(srv_a.addr)
+    assert conn is not None
+    conn.close()
+    tb.join(60)
+    tc.join(90)
+    assert res.get("b") is False          # B's pull legitimately failed
+    assert not sb.contains(oid)           # no poisoned unsealed entry
+    assert res.get("c") is True           # C failed over to the root
+    assert pull_c.source_failovers >= 1
+    assert pull_c.bytes_by_source.get(srv_a.addr, 0) > 0
+    assert _fetch_bytes(sc, oid) == payload
+
+
+def test_freed_slot_mid_serve_is_safe(xfer):
+    """Deleting the backing entry mid-relay (the abort/eviction shape)
+    must produce OBJ_PULL_FAIL + failover, never bytes from a recycled
+    arena slot."""
+    (sa, srv_a, _pa) = xfer()
+    (sb, sb_srv, _pb) = xfer()
+    (sc, _sc_srv, pull_c) = xfer()
+    oid, payload = ObjectID.from_random(), _payload(4 * 1024 * 1024, seed=5)
+    _seed(sa, oid, payload)
+    # hand-build B's in-progress state: half the object present
+    half = len(payload) // 2
+    buf = sb.create(oid, len(payload))
+    buf[:half] = payload[:half]
+    part = sb.begin_partial(oid, buf, len(payload), b"")
+    part.mark(0, half)
+    del buf
+
+    res = {}
+    tc = threading.Thread(target=lambda: res.setdefault(
+        "c", pull_c.pull(oid, [sb_srv.addr, srv_a.addr], timeout=60,
+                         size_hint=len(payload), max_sources=1,
+                         relay_addrs=[sb_srv.addr])))
+    tc.start()
+    _wait_for(lambda: pull_c.bytes_by_source.get(sb_srv.addr, 0) > 0,
+              msg="C to stream from the partial")
+    sb.delete(oid)  # B's pull "aborts": slot freed under the relay
+    tc.join(60)
+    assert res.get("c") is True
+    assert pull_c.source_failovers >= 1
+    assert _fetch_bytes(sc, oid) == payload
+
+
+def test_striped_upstream_relays_out_of_order_arrivals(xfer):
+    """B stripes its pull across TWO roots (chunks land out of order in
+    B's buffer); C relays through B and must still see exact bytes —
+    availability is an interval set, not a high-water mark."""
+    (sa1, srv_a1, _p1) = xfer()
+    (sa2, srv_a2, _p2) = xfer()
+    (sb, sb_srv, pull_b) = xfer()
+    (sc, _sc_srv, pull_c) = xfer()
+    oid, payload = ObjectID.from_random(), _payload(8 * 1024 * 1024, seed=6)
+    _seed(sa1, oid, payload)
+    _seed(sa2, oid, payload)
+    srv_a1.throttle_s = 0.03  # stripe halves advance at different rates
+    srv_a2.throttle_s = 0.005
+
+    res = {}
+    tb = threading.Thread(target=lambda: res.setdefault(
+        "b", pull_b.pull(oid, [srv_a1.addr, srv_a2.addr], timeout=60,
+                         size_hint=len(payload))))
+    tb.start()
+    _wait_for(lambda: sb.partial(oid) is not None or sb.contains(oid),
+              msg="B's striped pull to begin")
+    ok_c = pull_c.pull(oid, [sb_srv.addr], timeout=60,
+                       size_hint=len(payload), relay_addrs=[sb_srv.addr])
+    tb.join(60)
+    assert res.get("b") is True and ok_c is True
+    assert pull_b.multi_source_pulls == 1
+    assert _fetch_bytes(sc, oid) == payload
+
+
+def test_seal_racing_relay_read_switches_to_handoff(xfer, monkeypatch):
+    """seal() can land between wait_covered() returning "ok" and the
+    relay's read() (which then sees the dropped buffer): the relay must
+    switch to the sealed-copy handoff, never send OBJ_PULL_FAIL for an
+    object that is fully present locally."""
+    (sb, sb_srv, _pb) = xfer()
+    (sc, _sc_srv, pull_c) = xfer()
+    oid, payload = ObjectID.from_random(), _payload(3 * 1024 * 1024, seed=8)
+    buf = sb.create(oid, len(payload))
+    buf[:] = payload
+    part = sb.begin_partial(oid, buf, len(payload), b"")
+    part.mark(0, len(payload))
+    del buf
+
+    fired = []
+    orig_read = PartialObject.read
+
+    def racing_read(self, s, e):
+        if self is part and not fired:
+            fired.append(True)
+            sb.seal(oid)  # finish(sealed=True) drops part.buf under us
+        return orig_read(self, s, e)
+
+    monkeypatch.setattr(PartialObject, "read", racing_read)
+    assert pull_c.pull(oid, [sb_srv.addr], timeout=60,
+                       size_hint=len(payload), relay_addrs=[sb_srv.addr])
+    assert _fetch_bytes(sc, oid) == payload
+    assert pull_c.source_failovers == 0  # no FAIL frame was ever sent
+    assert fired
+
+
+def test_plain_pull_ignores_partial_and_fails_fast(xfer):
+    """A pull the head did NOT mark as relay-served (wait_s=0 — e.g. a
+    stale directory entry) must get the immediate META -1 failover, not
+    a chunk-by-chunk dribble behind someone else's stalled pull."""
+    (sa, srv_a, _pa) = xfer()
+    (sb, sb_srv, _pb) = xfer()
+    (sc, _sc_srv, pull_c) = xfer()
+    oid, payload = ObjectID.from_random(), _payload(4 * 1024 * 1024, seed=9)
+    _seed(sa, oid, payload)
+    # B has a STALLED partial: half present, the rest never arriving
+    half = len(payload) // 2
+    buf = sb.create(oid, len(payload))
+    buf[:half] = payload[:half]
+    part = sb.begin_partial(oid, buf, len(payload), b"")
+    part.mark(0, half)
+    del buf
+
+    t0 = time.monotonic()
+    assert pull_c.pull(oid, [sb_srv.addr, srv_a.addr], timeout=60,
+                       size_hint=len(payload))  # NOT relay-marked
+    assert time.monotonic() - t0 < 5.0  # no per-chunk wait budget burned
+    assert sb_srv.served_relay == 0     # the partial was never served
+    assert pull_c.source_failovers >= 1  # META -1 -> failover to A
+    assert _fetch_bytes(sc, oid) == payload
+
+
+def test_relay_pull_racing_completed_abort_fails_fast(xfer):
+    """B's pull aborted (partial deleted) BEFORE C's relay-marked pull
+    arrives: the aborted tombstone answers META -1 immediately — C must
+    fail over to the root without burning the serve-wait budget."""
+    (sa, srv_a, _pa) = xfer()
+    (sb, sb_srv, _pb) = xfer()
+    (sc, _sc_srv, pull_c) = xfer()
+    oid, payload = ObjectID.from_random(), _payload(2 * 1024 * 1024,
+                                                   seed=10)
+    _seed(sa, oid, payload)
+    buf = sb.create(oid, len(payload))
+    sb.begin_partial(oid, buf, len(payload), b"")
+    del buf
+    sb.delete(oid)  # the abort completed; only the tombstone remains
+
+    t0 = time.monotonic()
+    assert pull_c.pull(oid, [sb_srv.addr, srv_a.addr], timeout=60,
+                       size_hint=len(payload), max_sources=1,
+                       relay_addrs=[sb_srv.addr])
+    assert time.monotonic() - t0 < get_config().broadcast_serve_wait_s
+    assert pull_c.source_failovers >= 1
+    assert _fetch_bytes(sc, oid) == payload
+
+
+def test_non_relay_pull_of_missing_object_still_fails_fast(xfer):
+    """wait_s rides only relay-marked pulls: a stale directory entry
+    (no relay flag) keeps the immediate META -1 failover."""
+    (sa, srv_a, _pa) = xfer()
+    (sb, srv_b, _pb) = xfer()
+    (sc, _sc_srv, pull_c) = xfer()
+    oid, payload = ObjectID.from_random(), _payload(2 * 1024 * 1024, seed=7)
+    _seed(sa, oid, payload)  # B does NOT hold it and never will
+    t0 = time.monotonic()
+    assert pull_c.pull(oid, [srv_b.addr, srv_a.addr], timeout=60,
+                       size_hint=len(payload))
+    assert time.monotonic() - t0 < get_config().broadcast_serve_wait_s
+    assert _fetch_bytes(sc, oid) == payload
+
+
+# ------------------------------------------------- head fan-out planner
+
+
+class _FakeConn:
+    def __init__(self):
+        self.replies = []
+        self.peer = ""
+        self.on_close = None
+        self.closed = False
+
+    def reply(self, rid, *fields, msg_type=None):
+        self.replies.append(fields)
+
+    def reply_error(self, rid, err):
+        pass
+
+    def send(self, *a, **k):
+        pass
+
+    def close(self):
+        self.closed = True
+
+
+@pytest.fixture
+def bcast_head(tmp_path):
+    """Head + 1 local node + 5 fake 'remote' nodes with distinct
+    transfer addresses, so planner decisions are observable without
+    processes."""
+    from ray_tpu.core.head import Head
+
+    h = Head(str(tmp_path), f"tb_{ObjectID.from_random().hex()[:8]}")
+    h.add_node(num_cpus=1, object_store_memory=8 * 1024 * 1024)
+    for i in range(1, 6):
+        rs = ResourceSet({"CPU": 1})
+        h.register_remote_node(
+            _FakeConn(), NodeResources(total=rs, available=rs),
+            f"fake_store_{i}", f"10.0.0.{i}", "/tmp/x",
+            transfer_addr=f"tcp:10.0.0.{i}:70{i}0")
+    yield h
+    h.shutdown()
+
+
+def _plan(head, oid, dst_idx):
+    with head._lock:
+        loc = head.objects[oid]
+    return head._plan_pull_sources(oid, loc, head.nodes[dst_idx])
+
+
+def _sealed_obj(head, oid, node_idx=1, size=4 * 1024 * 1024):
+    head._h_object_sealed(_FakeConn(), 0, oid.binary(), node_idx, size,
+                          "owner")
+
+
+def test_planner_bounds_root_fanout_then_relays(bcast_head):
+    h = bcast_head
+    cfg = get_config()
+    old = cfg.broadcast_fanout
+    cfg.broadcast_fanout = 2
+    try:
+        oid = ObjectID.from_random()
+        _sealed_obj(h, oid, node_idx=1)
+        root = h.nodes[1].transfer_addr
+
+        a2, r2, m2, c2 = _plan(h, oid, 2)
+        a3, r3, m3, c3 = _plan(h, oid, 3)
+        # roots under the bound: both go straight to the sealed holder
+        assert a2[0] == root and not r2 and c2 == [(root, 1.0)]
+        assert a3[0] == root and not r3
+        # root now saturated (fanout=2): next puller rides a relay
+        a4, r4, m4, c4 = _plan(h, oid, 4)
+        relay_addrs = {h.nodes[2].transfer_addr, h.nodes[3].transfer_addr}
+        assert r4 and r4[0] in relay_addrs and m4 == 1
+        assert a4[0] == r4[0] and root in a4  # root kept as failover tail
+        with h._lock:
+            assert h.objects[oid].serving[root] == 2
+        assert h.broadcast_relay_assignments >= 1
+        # completion releases the slot: the NEXT puller goes to the root
+        h._finish_pull_assignment(oid, 2, c2)
+        a5, r5, m5, c5 = _plan(h, oid, 5)
+        assert a5[0] == root and not r5
+    finally:
+        cfg.broadcast_fanout = old
+
+
+def test_planner_striped_pulls_charge_fractionally(bcast_head):
+    """A pull striped across k roots takes ~1/k of each uplink and must
+    charge 1/k — ordinary multi-holder striped workloads must neither
+    saturate the roots nor fire the broadcast saturation event."""
+    h = bcast_head
+    cfg = get_config()
+    old = cfg.broadcast_fanout
+    cfg.broadcast_fanout = 2
+    try:
+        oid = ObjectID.from_random()
+        _sealed_obj(h, oid, node_idx=1)
+        h._h_obj_location_add(_FakeConn(), 0, oid.binary(), 2)
+        h._h_obj_location_add(_FakeConn(), 0, oid.binary(), 3)
+        sat0 = h.broadcast_fanout_saturations
+        plans = [_plan(h, oid, i) for i in (4, 5)]
+        for a, r, m, c in plans:
+            assert m == 3 and not r  # both striped across all 3 roots
+        with h._lock:
+            for load in h.objects[oid].serving.values():
+                assert load < cfg.broadcast_fanout  # 2/3 each, not 2
+        assert h.broadcast_fanout_saturations == sat0
+        # releases cancel the fractional charges exactly
+        for i, (_a, _r, _m, c) in zip((4, 5), plans):
+            h._finish_pull_assignment(oid, i, c)
+        with h._lock:
+            assert not h.objects[oid].serving
+    finally:
+        cfg.broadcast_fanout = old
+
+
+def test_planner_aborted_inprog_location_never_rehanded(bcast_head):
+    """Directory staleness on abort: once a puller's assignment is
+    finished (failed), its address must not be offered as a relay."""
+    h = bcast_head
+    cfg = get_config()
+    old = cfg.broadcast_fanout
+    cfg.broadcast_fanout = 1
+    try:
+        oid = ObjectID.from_random()
+        _sealed_obj(h, oid, node_idx=1)
+        a2, _r2, _m2, c2 = _plan(h, oid, 2)       # node2 -> root
+        h._finish_pull_assignment(oid, 2, c2)     # ...and it ABORTS
+        with h._lock:
+            assert 2 not in h.objects[oid].inprog
+        a3, r3, _m3, _c3 = _plan(h, oid, 3)       # root free again -> root
+        assert not r3 and a3[0] == h.nodes[1].transfer_addr
+        a4, r4, _m4, _c4 = _plan(h, oid, 4)       # root saturated -> relay
+        assert r4 and r4[0] != h.nodes[2].transfer_addr, \
+            "aborted in-progress location handed out as a relay"
+    finally:
+        cfg.broadcast_fanout = old
+
+
+def test_planner_saturation_falls_back_and_emits_event(bcast_head):
+    h = bcast_head
+    cfg = get_config()
+    old = cfg.broadcast_fanout
+    cfg.broadcast_fanout = 1
+    try:
+        oid = ObjectID.from_random()
+        _sealed_obj(h, oid, node_idx=1)
+        root = h.nodes[1].transfer_addr
+        _plan(h, oid, 2)                     # root now saturated
+        # same dst replans (its first pull still in flight): no relay
+        # candidate (itself excluded), every root at the bound
+        sat0 = h.broadcast_fanout_saturations
+        a, r, m, _c = _plan(h, oid, 2)
+        assert a[0] == root and not r and m == 1
+        assert h.broadcast_fanout_saturations == sat0 + 1
+        events = [e for e in h.cluster_events
+                  if e[5] == "broadcast_fanout_saturated"]
+        assert events, "saturation event never emitted"
+    finally:
+        cfg.broadcast_fanout = old
+
+
+def test_planner_disabled_and_small_objects_keep_old_plan(bcast_head):
+    h = bcast_head
+    cfg = get_config()
+    old = cfg.broadcast_fanout
+    try:
+        # small object: full sealed holder set, no accounting
+        oid = ObjectID.from_random()
+        _sealed_obj(h, oid, node_idx=1, size=64 * 1024)
+        a, r, m, c = _plan(h, oid, 2)
+        assert a == [h.nodes[1].transfer_addr] and not r and m == 0 \
+            and c == []
+        with h._lock:
+            assert not h.objects[oid].inprog
+        # knob off: same for large objects
+        cfg.broadcast_fanout = 0
+        oid2 = ObjectID.from_random()
+        _sealed_obj(h, oid2, node_idx=1)
+        a2, r2, m2, c2 = _plan(h, oid2, 2)
+        assert a2 == [h.nodes[1].transfer_addr] and m2 == 0 and c2 == []
+    finally:
+        cfg.broadcast_fanout = old
+
+
+def test_planner_node_death_clears_broadcast_state(bcast_head):
+    h = bcast_head
+    cfg = get_config()
+    old = cfg.broadcast_fanout
+    cfg.broadcast_fanout = 1
+    try:
+        oid = ObjectID.from_random()
+        _sealed_obj(h, oid, node_idx=1)
+        _plan(h, oid, 2)                       # node2 in progress
+        a3, r3, _m3, _c3 = _plan(h, oid, 3)    # node3 relays off node2
+        assert r3 == (h.nodes[2].transfer_addr,)
+        h.remove_node(2, kill_workers=False)
+        with h._lock:
+            loc = h.objects[oid]
+            assert 2 not in loc.inprog
+            assert "tcp:10.0.0.2:7020" not in loc.serving
+        # replanning for a new puller never routes at the dead node
+        a4, r4, _m4, _c4 = _plan(h, oid, 4)
+        assert "tcp:10.0.0.2:7020" not in a4
+    finally:
+        cfg.broadcast_fanout = old
+
+
+def test_p2p_timeout_surfaces_and_releases_assignment(bcast_head):
+    """A brokered pull that times out must NOT fall through to the
+    head-memory relay path (it would collide with the agent's still-
+    running pull); the error surfaces and the charges/in-progress entry
+    are released."""
+    h = bcast_head
+    oid = ObjectID.from_random()
+    _sealed_obj(h, oid, node_idx=1)
+    dst = h.nodes[2]
+
+    def timed_out_call(*a, **k):
+        raise TimeoutError("pull still running")
+
+    dst.agent_conn.call = timed_out_call
+    with h._lock:
+        loc = h.objects[oid]
+    with pytest.raises(TimeoutError):
+        h._p2p_transfer(oid, loc, dst)
+    with h._lock:
+        assert 2 not in loc.inprog and not loc.serving
+
+
+def test_object_plane_state_has_broadcast_counters(bcast_head):
+    h = bcast_head
+    oid = ObjectID.from_random()
+    _sealed_obj(h, oid, node_idx=1)
+    _plan(h, oid, 2)
+    c = _FakeConn()
+    h._h_state_query(c, 1, "object_plane", 1)
+    (rows,) = c.replies[0]
+    row = rows[0]
+    assert row["inprog_locations"] == 1
+    assert row["broadcast_root_assignments"] >= 1
+    assert {"broadcast_relay_assignments",
+            "broadcast_fanout_saturations"} <= set(row)
+
+
+# ------------------------------------------------- cluster integration
+
+
+@pytest.fixture
+def tcp_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2, "num_tpus": 0})
+    handles = []
+    yield cluster, handles
+    for h in handles:
+        h.terminate()
+    cluster.shutdown()
+
+
+def _transfer(head, oid, node_idx, out=None, key=None):
+    """Drive the brokered pull exactly like a worker's cold get()."""
+    from ray_tpu.core.context import get_context
+
+    try:
+        get_context().head.call(P.OBJECT_TRANSFER, oid.binary(), node_idx,
+                                timeout=120)
+        ok = True
+    except Exception:  # noqa: BLE001
+        ok = False
+    if out is not None:
+        out[key] = ok
+    return ok
+
+
+def test_cluster_cold_broadcast_bounded_root_egress(tcp_cluster):
+    """Two agents pull the same cold object simultaneously with
+    broadcast_fanout=1: the root (head) serves exactly ONE stream and
+    the second agent's bytes ride the first agent's relay."""
+    import ray_tpu.core.api as core_api
+
+    cluster, handles = tcp_cluster
+    cfg = get_config()
+    old = cfg.broadcast_fanout
+    cfg.broadcast_fanout = 1
+    try:
+        r1 = cluster.add_remote_node(num_cpus=1)
+        r2 = cluster.add_remote_node(num_cpus=1)
+        handles.extend([r1, r2])
+        head = core_api._head
+
+        payload = np.random.default_rng(11).integers(
+            0, 255, 8 * 1024 * 1024, dtype=np.uint8)
+        ref = ray_tpu.put(payload)
+        _wait_for(lambda: ref.id in head.objects, msg="put to register")
+        with head._lock:
+            obj_size = head.objects[ref.id].size
+        head._transfer_server.throttle_s = 0.05  # stretch the root serve
+        served0 = head._transfer_server.pull_requests
+        relay0 = head.relay_bytes
+
+        out = {}
+        threads = [
+            threading.Thread(target=_transfer, daemon=True,
+                             args=(head, ref.id, r1.node_idx, out, "r1")),
+            threading.Thread(target=_transfer, daemon=True,
+                             args=(head, ref.id, r2.node_idx, out, "r2")),
+        ]
+        threads[0].start()
+        time.sleep(0.2)  # r1's pull is in flight when r2 plans
+        threads[1].start()
+        for t in threads:
+            t.join(120)
+        head._transfer_server.throttle_s = 0.0
+        assert out == {"r1": True, "r2": True}
+        # the fan-out bound held: the holder served ONE puller; the
+        # other rode the relay (this IS the per-holder OBJ_PULL bound)
+        assert head._transfer_server.pull_requests - served0 == 1
+        assert head._transfer_server.bytes_served <= 2 * obj_size
+        # payload bytes never transited head memory
+        assert head.relay_bytes == relay0
+        with head._lock:
+            holders = set(head.objects[ref.id].holders)
+            assert {r1.node_idx, r2.node_idx} <= holders
+            assert not head.objects[ref.id].inprog   # all retired
+            assert not head.objects[ref.id].serving  # all released
+        assert head.broadcast_relay_assignments >= 1
+        # both agents hold the exact bytes (read through the agent RPC;
+        # this verification path legitimately relays through the head)
+        for h in (r1, r2):
+            data, _meta = head._node_store_read(head.nodes[h.node_idx],
+                                                ref.id)
+            assert len(data) == obj_size
+    finally:
+        cfg.broadcast_fanout = old
+
+
+def test_cluster_relay_agent_killed_mid_tree(tcp_cluster):
+    """Kill the relay agent while a downstream agent streams through
+    it: the downstream pull fails over to the root holder set and
+    completes — and the directory never re-offers the dead relay."""
+    import ray_tpu.core.api as core_api
+
+    cluster, handles = tcp_cluster
+    cfg = get_config()
+    old = cfg.broadcast_fanout
+    cfg.broadcast_fanout = 1
+    try:
+        r1 = cluster.add_remote_node(num_cpus=1)
+        r2 = cluster.add_remote_node(num_cpus=1)
+        handles.extend([r1, r2])
+        head = core_api._head
+
+        payload = np.random.default_rng(13).integers(
+            0, 255, 8 * 1024 * 1024, dtype=np.uint8)
+        ref = ray_tpu.put(payload)
+        _wait_for(lambda: ref.id in head.objects, msg="put to register")
+        head._transfer_server.throttle_s = 0.05  # r1's pull >= 400 ms
+
+        out = {}
+        t1 = threading.Thread(target=_transfer, daemon=True,
+                              args=(head, ref.id, r1.node_idx, out, "r1"))
+        t2 = threading.Thread(target=_transfer, daemon=True,
+                              args=(head, ref.id, r2.node_idx, out, "r2"))
+        t1.start()
+        time.sleep(0.25)   # r1 mid-pull...
+        t2.start()         # ...so r2 is planned onto the r1 relay
+        time.sleep(0.25)
+        r1.terminate()     # mid-tree relay dies
+        head._transfer_server.throttle_s = 0.0
+        t2.join(120)
+        assert out.get("r2") is True, "downstream pull never failed over"
+        with head._lock:
+            assert r2.node_idx in head.objects[ref.id].holders
+            assert r1.node_idx not in head.objects[ref.id].inprog
+            obj_size = head.objects[ref.id].size
+        data, _meta = head._node_store_read(head.nodes[r2.node_idx],
+                                            ref.id)
+        assert len(data) == obj_size
+        # t1's transfer targeted the dead node; it may only resolve by
+        # timeout — don't wait on it (daemon thread, cluster teardown
+        # unblocks it)
+    finally:
+        cfg.broadcast_fanout = old
+
+
+def test_collective_broadcast_rides_cooperative_path(tcp_cluster):
+    """collective.broadcast for world_size 5 (src on the head node, 4
+    remote receivers): payload bytes never transit head memory and the
+    holder's egress stays under 2 x object size."""
+    import ray_tpu.core.api as core_api
+    from ray_tpu.core.api import NodeAffinitySchedulingStrategy
+
+    cluster, handles = tcp_cluster
+    head = core_api._head
+    for _ in range(4):
+        handles.append(cluster.add_remote_node(num_cpus=1))
+
+    @ray_tpu.remote(num_cpus=1)
+    class Rank:
+        def init(self, world, rank):
+            from ray_tpu import collective
+
+            collective.init_collective_group(world, rank,
+                                             group_name="bcast")
+            return True
+
+        def bcast(self, rank):
+            from ray_tpu import collective
+
+            arr = (np.arange(1024 * 1024, dtype=np.float32) if rank == 0
+                   else np.zeros(1024 * 1024, dtype=np.float32))
+            out = collective.broadcast(arr, src_rank=0,
+                                       group_name="bcast",
+                                       transport="object")
+            return float(out[-1]), float(out.sum(dtype=np.float64))
+
+    world = 5
+    actors = [Rank.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            0 if i == 0 else handles[i - 1].node_idx)).remote()
+        for i in range(world)]
+    ray_tpu.get([a.init.remote(world, i) for i, a in enumerate(actors)],
+                timeout=180)
+    relay0 = head.relay_bytes
+    served0 = head._transfer_server.bytes_served
+    # stretch each root serve to ~250 ms so the 4 receivers' gets
+    # genuinely overlap even on a loaded host (the cooperative regime;
+    # unthrottled loopback serves finish before the 2nd receiver even
+    # plans, and a receiver that misses the window stripes off the root)
+    head._transfer_server.throttle_s = 0.05
+    try:
+        results = ray_tpu.get(
+            [a.bcast.remote(i) for i, a in enumerate(actors)],
+            timeout=300)
+    finally:
+        head._transfer_server.throttle_s = 0.0
+    expect_last = float(1024 * 1024 - 1)
+    expect_sum = float(np.arange(1024 * 1024,
+                                 dtype=np.float32).sum(dtype=np.float64))
+    for last, ssum in results:
+        assert last == expect_last and ssum == expect_sum
+    # payload never relayed through head memory
+    assert head.relay_bytes == relay0
+    # the source holder's egress is bounded by the fan-out, far below
+    # world_size x S (4 MiB payload, fanout=2 default)
+    size = 4 * 1024 * 1024
+    assert head._transfer_server.bytes_served - served0 < 2 * size + \
+        1024 * 1024

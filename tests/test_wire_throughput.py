@@ -97,6 +97,79 @@ def test_wire_throughput_smoke(ray_start_cluster):
         "TASK_DONE_BATCH never engaged for a 5k-call flood"
 
 
+def test_cold_broadcast_smoke(ray_start_cluster):
+    """Tier-1 2-node broadcast smoke: two agents pull one cold 8 MiB
+    put concurrently through the cooperative object plane — the wire
+    counters must show the relay carried real traffic (the root holder
+    served ONE stream, not two) and every byte must come back intact
+    via real worker tasks."""
+    import ray_tpu.core.api as core_api
+    from ray_tpu.core.api import NodeAffinitySchedulingStrategy
+    from ray_tpu.core.config import get_config
+
+    cluster = ray_start_cluster
+    head = core_api._head
+    cfg = get_config()
+    old_fanout = cfg.broadcast_fanout
+    handles = []
+    try:
+        # config flip + node spawn INSIDE the try: a setup failure must
+        # not leak fanout=1 or live agent processes into later tests
+        cfg.broadcast_fanout = 1  # 2nd puller MUST relay off the 1st
+
+        @ray_tpu.remote(num_cpus=1)
+        def digest(arr):
+            return int(arr.sum(dtype=np.int64)), arr.shape[0]
+
+        handles.extend(cluster.add_remote_node(num_cpus=1)
+                       for _ in range(2))
+        # warm the worker pools so both gets race, then stretch the
+        # root's serve so the second planner call sees an in-flight pull
+        ray_tpu.get([digest.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                h.node_idx)).remote(np.zeros(8, dtype=np.uint8))
+            for h in handles], timeout=120)
+        # a scheduling stall > the throttled serve time would let the
+        # pulls run back-to-back instead of overlapping — retry with a
+        # fresh object until the race actually happens (first attempt
+        # in practice), THEN assert the fan-out bound held
+        for attempt in range(3):
+            blob = np.random.default_rng(21 + attempt).integers(
+                0, 255, 8 * 1024 * 1024, dtype=np.uint8)
+            ref = ray_tpu.put(blob)
+            served0 = head._transfer_server.pull_requests
+            relayed0 = head.broadcast_relay_assignments
+            relay_bytes0 = head.relay_bytes
+            head._transfer_server.throttle_s = 0.1  # root serve ~0.9s
+            try:
+                refs = [digest.options(
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        h.node_idx)).remote(ref) for h in handles]
+                got = ray_tpu.get(refs, timeout=180)
+            finally:
+                head._transfer_server.throttle_s = 0.0
+            expect = (int(blob.sum(dtype=np.int64)), blob.shape[0])
+            assert got == [expect, expect]  # bytes intact on both hosts
+            if head.broadcast_relay_assignments > relayed0:
+                break  # the pulls overlapped: the relay tree engaged
+        else:
+            raise AssertionError("concurrent pulls never overlapped in "
+                                 "3 attempts")
+        # relay traffic really happened: the holder's transfer server
+        # saw exactly ONE OBJ_PULL for this object; the other agent's
+        # copy arrived through the in-progress relay
+        assert head._transfer_server.pull_requests - served0 == 1
+        assert head.relay_bytes == relay_bytes0  # never through head mem
+        with head._lock:
+            loc = head.objects[ref.id]
+            assert {h.node_idx for h in handles} <= loc.holders
+            assert not loc.inprog and not loc.serving
+    finally:
+        cfg.broadcast_fanout = old_fanout
+        for h in handles:
+            h.terminate()
+
+
 @ray_tpu.remote
 class _FastSlow:
     def fast(self):
