@@ -157,6 +157,30 @@ class Config:
     # --- logging / events ---
     log_dir: str = ""
     task_event_buffer_size: int = 10000
+    # Folded per-task lifecycle timelines on the head (state_ts /
+    # phase_ms rows behind `state.list_tasks`): max tasks retained,
+    # FIFO-evicted by last activity. Independent of the raw event ring —
+    # a timeline survives ring overflow. <= 0 disables folding entirely
+    # (list_tasks goes empty; the raw ring still serves task_events).
+    task_timeline_max_entries: int = 10000
+    # Straggler detection (head-side detector thread over the folded
+    # timelines). A RUNNING task is flagged — once, with one rate-limited
+    # `task_straggler` cluster event naming task/node/worker — when its
+    # current exec time exceeds `straggler_factor` x the p95 of its
+    # func's COMPLETED exec distribution (task.phase_ms{func,exec}
+    # histogram). The robust-bound comparison only arms once that
+    # distribution holds at least `straggler_min_samples` completions
+    # (the min-sample gate: p95 of two data points is noise, and a
+    # brand-new func must not alarm on its first long run). The same
+    # factor+gate drive the per-node phase-skew check (`slow_node`
+    # events when one node's dispatch/arg_fetch p95 is factor x the
+    # cluster median and at least 5ms over it).
+    straggler_factor: float = 3.0
+    straggler_min_samples: int = 5
+    # Detector sweep period, seconds; <= 0 disables the detector thread
+    # entirely (timelines and histograms still fold — only the
+    # task_straggler / slow_node flagging stops).
+    straggler_detect_period_s: float = 1.0
     # Head-side ring buffer for the structured cluster event log
     # (reference: the GCS event aggregator behind `ray list
     # cluster-events`). Overflow drops the oldest and counts the drops.

@@ -803,8 +803,12 @@ class CoreContext:
                 st = self._classes.setdefault(cls, _ClassState())
                 st.queue.append(spec)
         if not holder:
+            self.events.record(spec.task_id.hex(), spec.name,
+                               task_events.PENDING_NODE_ASSIGNMENT)
             self._submit_event.set()
             return refs
+        self.events.record(spec.task_id.hex(), spec.name,
+                           task_events.PENDING_ARGS_AVAIL)
         self._resolve_then(spec, holder,
                            lambda: self._enqueue_ready(spec, cls))
         return refs
@@ -813,6 +817,8 @@ class CoreContext:
         with self._sub_lock:
             st = self._classes.setdefault(cls, _ClassState())
             st.queue.append(spec)
+        self.events.record(spec.task_id.hex(), spec.name,
+                           task_events.PENDING_NODE_ASSIGNMENT)
         self._submit_event.set()
 
     def _resolve_then(self, spec: TaskSpec, holder, on_ready, on_error=None):
@@ -971,6 +977,9 @@ class CoreContext:
                     worker.conn.send(P.PUSH_TASK, batch[0], 0)
                 else:
                     worker.conn.send(P.PUSH_TASK_BATCH, batch)
+                for spec in batch:
+                    self.events.record(spec.task_id.hex(), spec.name,
+                                       task_events.SUBMITTED_TO_WORKER)
             except P.ConnectionLost:
                 self._on_lease_worker_lost(cls, st, worker)
         for w in to_release:
@@ -1101,7 +1110,16 @@ class CoreContext:
         else:
             self._complete_task_error(spec, err)
 
-    def _complete_task_error(self, spec: TaskSpec, err: Exception):
+    def _complete_task_error(self, spec: TaskSpec, err: Exception,
+                             state: str = task_events.FAILED):
+        # Owner-side terminal stamp: a task can die WITHOUT a worker
+        # ever recording FAILED (worker crash with retries exhausted,
+        # dep-resolution failure, actor death) — without this the folded
+        # timeline wedges at RUNNING and the straggler detector flags a
+        # task the caller already received an error for.
+        self.events.record(spec.task_id.hex(),
+                           spec.name or spec.method_name, state,
+                           error=repr(err))
         aborted = []
         for oid in spec.return_ids():
             # don't clobber results that already arrived (e.g. an actor
@@ -1130,7 +1148,9 @@ class CoreContext:
                 self.ref_counter.remove_task_arg(oid)
 
     def _finish_cancelled(self, spec: TaskSpec):
-        self._complete_task_error(spec, TaskCancelledError(spec.task_id.hex()))
+        self._complete_task_error(spec,
+                                  TaskCancelledError(spec.task_id.hex()),
+                                  state=task_events.CANCELLED)
 
     def cancel(self, ref: ObjectRef, force: bool = False):
         with self._sub_lock:
@@ -1178,6 +1198,10 @@ class CoreContext:
             # when the actor restarts.
             self._handle_actor_reply(task_id, status, result_meta, err)
             return
+        # result_return / e2e phase endpoint: the reply landed back at
+        # the owner (recorded whatever the status — an error "returns"
+        # too; retries re-open the timeline from their own dispatch)
+        self.events.record(task_id.hex(), spec.name, task_events.RETURNED)
         if status == "ok":
             self._store_results(spec, result_meta)
             self._finalize_task(spec)
@@ -1276,6 +1300,12 @@ class CoreContext:
             trace_ctx=task_events.submit_trace_ctx(),
         )
         arg_ids, holder = self._encode_args(spec, args, kwargs)
+        self.events.record(task_id.hex(), spec.name, task_events.SUBMITTED,
+                           trace_id=spec.trace_ctx[0],
+                           parent_span_id=spec.trace_ctx[1])
+        if holder:
+            self.events.record(task_id.hex(), spec.name,
+                               task_events.PENDING_ARGS_AVAIL)
         refs = [ObjectRef(oid, self.worker_id, _register=False)
                 for oid in spec.return_ids()]
         for r in refs:
@@ -1294,6 +1324,10 @@ class CoreContext:
 
         def ready():
             self._dep_unready.discard(spec.task_id)
+            # args resolved: the task now waits only for the actor's
+            # connection + head-of-line order (its "node assignment")
+            self.events.record(task_id.hex(), spec.name,
+                               task_events.PENDING_NODE_ASSIGNMENT)
             self._drain_actor(st)
 
         def failed(err):
@@ -1349,6 +1383,9 @@ class CoreContext:
                     conn.send(P.PUSH_TASK, to_send[0], to_send[0].seqno)
                 elif to_send:
                     conn.send(P.PUSH_TASK_BATCH, to_send)
+                for spec in to_send:
+                    self.events.record(spec.task_id.hex(), spec.name,
+                                       task_events.SUBMITTED_TO_WORKER)
             except P.ConnectionLost:
                 pass  # conn.on_close handles re-resolution
 
@@ -1440,6 +1477,10 @@ class CoreContext:
         st = self._actor_state(spec.actor_id)
         with st.lock:
             st.inflight.pop(task_id, None)
+        if spec.task_type == TaskType.ACTOR_TASK:
+            self.events.record(task_id.hex(),
+                               spec.name or spec.method_name,
+                               task_events.RETURNED)
         if status == "ok":
             self._store_results(spec, result_meta)
             self._finalize_task(spec)
@@ -1606,6 +1647,18 @@ class CoreContext:
             traceback.print_exc()
         return None
 
+    def _mark_running(self, spec: TaskSpec):
+        """Stamp RUNNING once this task's args are materialized (the
+        FETCHING_ARGS->RUNNING gap is the arg_fetch phase). Trace ids
+        come from the TLS stash _execute set, so the RUNNING event pairs
+        with the same span FINISHED closes."""
+        info = getattr(self._task_tls, "exec_trace", None)
+        label, trace_id, span_id, parent_id = info or (
+            spec.name or spec.method_name or spec.function_id, "", "", "")
+        self.events.record(spec.task_id.hex(), label, task_events.RUNNING,
+                           trace_id=trace_id, span_id=span_id,
+                           parent_span_id=parent_id)
+
     def _decode_args(self, spec: TaskSpec):
         vals = []
         for entry in spec.args:
@@ -1632,19 +1685,28 @@ class CoreContext:
         submit site (spec.trace_ctx): the task's RUNNING->FINISHED pair
         IS the span, and the ambient trace context is installed for the
         duration so tracing.span() inside user code nests under it
-        (reference: tracing_helper.py _inject_tracing_into_function)."""
+        (reference: tracing_helper.py _inject_tracing_into_function).
+
+        FETCHING_ARGS is stamped on entry and RUNNING only after the
+        by-ref args resolved (_mark_running, called from each
+        _decode_args site) — the gap IS the arg_fetch phase, so a task
+        stalled pulling a remote arg is distinguishable from one
+        executing slowly."""
         label = spec.name or spec.method_name or spec.function_id
         trace_id, parent_id = spec.trace_ctx or ("", "")
         span_id = task_events.new_span_id() if trace_id else ""
-        self.events.record(spec.task_id.hex(), label, task_events.RUNNING,
+        self.events.record(spec.task_id.hex(), label,
+                           task_events.FETCHING_ARGS,
                            trace_id=trace_id, span_id=span_id,
                            parent_span_id=parent_id)
+        self._task_tls.exec_trace = (label, trace_id, span_id, parent_id)
         prev = task_events.set_trace(
             (trace_id, span_id) if trace_id else None)
         try:
             out = self._execute_inner(spec, conn)
         finally:
             task_events.set_trace(prev)
+            self._task_tls.exec_trace = None
         if out is None or out[1] == "ok":
             self.events.record(spec.task_id.hex(), label,
                                task_events.FINISHED,
@@ -1680,6 +1742,7 @@ class CoreContext:
                     _renv.applied(self, spec.runtime_env).__enter__()
                 cls = self.fn_manager.fetch(spec.function_id)
                 args, kwargs = self._decode_args(spec)
+                self._mark_running(spec)
                 self._actor_instance = cls(*args, **kwargs)
                 self._actor_spec = spec
                 if spec.name:
@@ -1698,17 +1761,20 @@ class CoreContext:
                     return None
                 fn = getattr(self._actor_instance, spec.method_name)
                 args, kwargs = self._decode_args(spec)
+                self._mark_running(spec)
                 result = self._call(fn, args, kwargs)
             elif spec.runtime_env:
                 from ray_tpu import runtime_env as _renv
 
                 fn = self.fn_manager.fetch(spec.function_id)
                 args, kwargs = self._decode_args(spec)
+                self._mark_running(spec)
                 with _renv.applied(self, spec.runtime_env):
                     result = self._call(fn, args, kwargs)
             else:
                 fn = self.fn_manager.fetch(spec.function_id)
                 args, kwargs = self._decode_args(spec)
+                self._mark_running(spec)
                 result = self._call(fn, args, kwargs)
         except Exception as e:  # noqa: BLE001
             te = TaskError(repr(e), traceback.format_exc(), e)

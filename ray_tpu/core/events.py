@@ -33,11 +33,81 @@ from typing import Optional, Tuple
 from . import protocol as P
 from .config import get_config
 
-# task states (reference: src/ray/protobuf/common.proto TaskStatus)
-SUBMITTED = "SUBMITTED"
-RUNNING = "RUNNING"
-FINISHED = "FINISHED"
-FAILED = "FAILED"
+# task states (reference: src/ray/protobuf/common.proto TaskStatus —
+# PENDING_ARGS_AVAIL -> PENDING_NODE_ASSIGNMENT -> SUBMITTED_TO_WORKER ->
+# RUNNING -> FINISHED). Each transition is stamped by the component that
+# owns it; the head folds the stamps into per-task timelines with a
+# per-phase latency breakdown (head.py task_timelines).
+SUBMITTED = "SUBMITTED"                        # driver: task created
+PENDING_ARGS_AVAIL = "PENDING_ARGS_AVAIL"      # driver: awaiting arg refs
+PENDING_NODE_ASSIGNMENT = "PENDING_NODE_ASSIGNMENT"  # driver: queued for a
+#                                                worker lease / actor conn
+SUBMITTED_TO_WORKER = "SUBMITTED_TO_WORKER"    # driver: pushed to a worker
+FETCHING_ARGS = "FETCHING_ARGS"                # worker: resolving by-ref args
+RUNNING = "RUNNING"                            # worker: user code entered
+FINISHED = "FINISHED"                          # worker: user code returned
+FAILED = "FAILED"                              # worker raised, OR the
+#                                                owner gave up (retries
+#                                                exhausted / worker lost)
+CANCELLED = "CANCELLED"                        # task cancelled
+RETURNED = "RETURNED"                          # driver: result landed back
+
+# Ordering of the lifecycle for "latest state" folding
+# (FINISHED/FAILED/CANCELLED share a rank — all terminal execution
+# states; RETURNED ranks past them but is never *displayed* as a task
+# state, matching the reference's TaskStatus surface).
+STATE_RANK = {
+    SUBMITTED: 0,
+    PENDING_ARGS_AVAIL: 1,
+    PENDING_NODE_ASSIGNMENT: 2,
+    SUBMITTED_TO_WORKER: 3,
+    FETCHING_ARGS: 4,
+    RUNNING: 5,
+    FINISHED: 6,
+    FAILED: 6,
+    CANCELLED: 6,
+    RETURNED: 7,
+}
+
+# THE phase definition table — the single source of truth shared by the
+# head fold, `derive_phase_ms`, and timeline()'s chrome-trace
+# sub-slices: (phase, start_states, end_states), first present stamp
+# wins in order. Durations come from MONOTONIC stamps carried alongside
+# the wall timestamps (wall is display-only); cross-node stamps are
+# folded into the head's monotonic timebase via the per-node clock
+# offsets before this math runs, and any residual skew clamps at 0 — a
+# phase is never negative.
+PHASE_BOUNDS = (
+    ("sched_wait", (PENDING_NODE_ASSIGNMENT,), (SUBMITTED_TO_WORKER,)),
+    ("dispatch", (SUBMITTED_TO_WORKER,), (FETCHING_ARGS,)),
+    ("arg_fetch", (FETCHING_ARGS,), (RUNNING,)),
+    ("exec", (RUNNING,), (FINISHED, FAILED)),
+    ("result_return", (FINISHED, FAILED), (RETURNED,)),
+    ("e2e", (SUBMITTED,), (RETURNED,)),
+)
+TASK_PHASES = tuple(name for name, _, _ in PHASE_BOUNDS)
+
+
+def _first_stamp(stamps: dict, states) -> Optional[float]:
+    for s in states:
+        v = stamps.get(s)
+        if v is not None:
+            return v
+    return None
+
+
+def derive_phase_ms(monos: dict) -> dict:
+    """Phase durations (ms, clamped >= 0) from a ``state -> monotonic``
+    stamp map in ONE timebase. Only phases whose both endpoints are
+    present appear — a running task shows sched_wait/dispatch/arg_fetch
+    while exec/result_return/e2e fill in as it completes."""
+    out = {}
+    for name, starts, ends in PHASE_BOUNDS:
+        a = _first_stamp(monos, starts)
+        b = _first_stamp(monos, ends)
+        if a is not None and b is not None:
+            out[name] = max(0.0, (b - a) * 1000.0)
+    return out
 
 # cluster-event severities (reference: src/ray/protobuf/
 # export_event.proto severity levels)
@@ -89,8 +159,11 @@ class TaskEventBuffer:
     """Owner/executor-side event buffer with periodic batched flush.
 
     Event tuples are ``(task_id_hex, name, state, worker_id, node_idx,
-    ts, error, trace_id, span_id, parent_span_id)`` — the trailing three
-    carry the cross-process trace tree (empty strings when untraced).
+    ts, error, trace_id, span_id, parent_span_id, mono)`` — trace ids
+    carry the cross-process trace tree (empty strings when untraced) and
+    ``mono`` is the recorder's ``time.monotonic()``: wall ``ts`` is
+    display-only, phase durations are computed from the monotonic stamps
+    (folded into the head's timebase via per-node clock offsets).
     """
 
     def __init__(self, head_conn, worker_id: str, node_idx: int):
@@ -120,7 +193,8 @@ class TaskEventBuffer:
                error: str = "", trace_id: str = "", span_id: str = "",
                parent_span_id: str = ""):
         ev = (task_id_hex, name, state, self._worker_id, self._node_idx,
-              time.time(), error, trace_id, span_id, parent_span_id)
+              time.time(), error, trace_id, span_id, parent_span_id,
+              time.monotonic())
         if len(self._events) == self._max:
             self._dropped += 1  # deque(maxlen) evicts the oldest
         self._events.append(ev)

@@ -21,12 +21,13 @@ from __future__ import annotations
 import itertools
 import os
 import queue
+import statistics
 import subprocess
 import sys
 import threading
 import time
 import uuid
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -103,6 +104,12 @@ class NodeState:
     health_failures: int = 0
     last_ping: float = 0.0
     ping_inflight: bool = False
+    # RTT-midpoint estimate of (agent monotonic clock - head monotonic
+    # clock), sampled at registration and refreshed by every health
+    # probe; applied when folding this node's task-event stamps into the
+    # head's timebase so cross-node phase math cannot go negative.
+    clock_offset_s: float = 0.0
+    clock_rtt_s: float = 0.0
 
     @property
     def is_remote(self) -> bool:
@@ -138,6 +145,58 @@ class _ObjLoc:
     # what bends N simultaneous pullers into a pipelined tree instead
     # of N streams off one uplink.
     serving: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class _TaskTimeline:
+    """Folded per-task lifecycle row (reference: GcsTaskManager's
+    per-task state aggregation over task_event_buffer flushes). Events
+    arrive out of order across connections; the fold is commutative —
+    first stamp per state wins, display state is the highest-ranked one
+    seen, and each phase is observed into the histograms exactly once,
+    the moment both its endpoints are present."""
+
+    task_id: str
+    name: str = ""
+    state: str = ""
+    worker_id: str = ""
+    node_idx: int = -1
+    ts: float = 0.0
+    error: str = ""
+    trace_id: str = ""
+    state_ts: Dict[str, float] = field(default_factory=dict)
+    # state -> monotonic stamp, already folded into the HEAD's timebase
+    # (remote stamps have their node's clock offset subtracted)
+    state_mono: Dict[str, float] = field(default_factory=dict)
+    observed: Set[str] = field(default_factory=set)  # phases histogrammed
+    straggler: bool = False
+    straggler_ms: float = 0.0
+
+
+# task.phase_ms / task.node_phase_ms bucket bounds (milliseconds): task
+# phases span sub-ms dispatch hops to multi-minute training steps.
+TASK_PHASE_MS_BOUNDARIES = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                            500.0, 1000.0, 2500.0, 5000.0, 15000.0,
+                            60000.0, 300000.0)
+
+
+def _hist_quantile(bounds, value, q: float) -> float:
+    """Estimate the q-quantile of a [bucket counts..., +inf, sum, n]
+    histogram row by linear interpolation inside the holding bucket
+    (the standard Prometheus histogram_quantile estimator); the +Inf
+    bucket clamps to the last finite bound."""
+    n = value[-1]
+    if n <= 0:
+        return 0.0
+    target = q * n
+    acc, lo = 0.0, 0.0
+    for i, b in enumerate(bounds):
+        c = value[i]
+        if c > 0 and acc + c >= target:
+            return lo + (b - lo) * max(0.0, min(1.0, (target - acc) / c))
+        acc += c
+        lo = b
+    return float(bounds[-1])
 
 
 class Head:
@@ -205,6 +264,19 @@ class Head:
         self.task_events: "deque" = deque(
             maxlen=get_config().task_event_buffer_size)
         self.task_events_dropped = 0
+        # Folded per-task lifecycle timelines (bounded, FIFO-evicted;
+        # reference: GcsTaskManager task aggregation): state_ts /
+        # phase_ms for list_tasks, the task.phase_ms{func,phase} +
+        # task.node_phase_ms{node,phase} histograms for
+        # summarize_tasks()/Prometheus, and the straggler flags.
+        self.task_timelines: "OrderedDict[str, _TaskTimeline]" = \
+            OrderedDict()
+        # node idx -> latest (remote_mono - head_mono) estimate; kept
+        # outside NodeState so stamps from already-dead nodes still fold
+        self.node_clock_offsets: Dict[int, float] = {}
+        self.stragglers_flagged = 0
+        self.slow_nodes_flagged = 0
+        self._last_slow_node_event: Dict[tuple, float] = {}
         # Structured cluster event log (reference: the GCS event
         # aggregator behind `ray list cluster-events`): severity-tagged
         # records from head-side emitters and any process's
@@ -283,6 +355,15 @@ class Head:
             lambda batch: self._h_metrics_report(None, 0, batch),
             _local_nodes)
         self._telemetry.start()
+        # Straggler detector: periodically compare each RUNNING task's
+        # current exec time against its func's completed-exec p95 and
+        # per-node phase p95s against the cluster median (reference
+        # motivation: one straggler gates every synchronous TPU step).
+        if get_config().straggler_detect_period_s > 0:
+            self._straggler_thread = threading.Thread(
+                target=self._straggler_loop, daemon=True,
+                name="head-straggler")
+            self._straggler_thread.start()
         # Worker spawner thread: fork+exec of an interpreter costs
         # 20-300 ms of syscalls — measured blocking the head IO loop
         # (and the head lock) for exactly that long per spawn when run
@@ -475,6 +556,15 @@ class Head:
                                         node_ip, session_dir, transfer_addr)
         conn.reply(rid, idx, self.session_name,
                    msg_type=P.REGISTER_NODE_REPLY)
+        # Handshake clock-offset probe: sample (agent_mono - head_mono)
+        # NOW rather than waiting for the first health-check period, so
+        # the node's very first task events already fold into the head
+        # timebase. Off-thread: the agent's PING reply rides this same
+        # IO thread.
+        node = self.nodes.get(idx)
+        if node is not None:
+            threading.Thread(target=self._ping_node, args=(node,),
+                             daemon=True, name="clock-probe").start()
         self._try_fulfill_pending()
 
     def remove_node(self, idx: int, kill_workers: bool = True):
@@ -491,6 +581,13 @@ class Head:
             for key in [k for k, row in self.metrics.items()
                         if k[0].startswith("node.")
                         and row["tags"] == {"node": str(idx)}]:
+                del self.metrics[key]
+            # ... and its per-node phase histograms: a removed node's
+            # frozen dispatch/arg_fetch distribution must not keep
+            # feeding the slow_node skew detector (or the exposition)
+            for key in [k for k, row in self.metrics.items()
+                        if k[0] == "task.node_phase_ms"
+                        and row["tags"].get("node") == str(idx)]:
                 del self.metrics[key]
         if node is None:
             return
@@ -2109,7 +2206,9 @@ class Head:
         (reference: GcsTaskManager; src/ray/gcs/gcs_server/gcs_task_manager.h).
         A request_id means the sender wants a flush-ack: the reply is
         issued only after ingestion, so a subsequent STATE_QUERY
-        observes this batch (tracing.timeline's ordering barrier)."""
+        observes this batch (tracing.timeline's ordering barrier).
+        Every event is ALSO folded into the bounded per-task timeline
+        table (state_ts / phase histograms / straggler bookkeeping)."""
         with self._lock:
             # count HEAD-ring evictions too (the deque drops oldest
             # silently) — the satellite drop counters must cover both
@@ -2118,8 +2217,301 @@ class Head:
                            - self.task_events.maxlen)
             self.task_events.extend(batch)
             self.task_events_dropped += dropped + overflow
+            for ev in batch:
+                self._fold_task_event(ev)
         if rid > 0:
             conn.reply(rid, True)
+
+    # --------------------------------------- task timelines / stragglers
+
+    def _fold_task_event(self, ev):
+        """Fold one task-state event into its timeline row (caller holds
+        the lock). Tolerates the pre-r10 10-field tuple shape (no
+        monotonic stamp: state_ts still fills, phases stay unknown)."""
+        from . import events as E
+
+        tid, name, state, wid, nidx, ts = ev[:6]
+        rank = E.STATE_RANK.get(state)
+        if rank is None:
+            return  # span records ride the raw ring only
+        err = ev[6] if len(ev) > 6 else ""
+        trace_id = ev[7] if len(ev) > 7 else ""
+        mono = ev[10] if len(ev) > 10 else None
+        # fold the recorder's monotonic stamp into the HEAD timebase
+        folded_mono = None if mono is None else \
+            mono - self.node_clock_offsets.get(nidx, 0.0)
+        row = self.task_timelines.get(tid)
+        if row is None:
+            cap = get_config().task_timeline_max_entries
+            if cap <= 0:
+                return  # folding disabled (raw ring still serves)
+            while len(self.task_timelines) >= cap:
+                self.task_timelines.popitem(last=False)
+            row = self.task_timelines[tid] = _TaskTimeline(task_id=tid)
+        self.task_timelines.move_to_end(tid)  # newest-activity-first view
+        if name:
+            row.name = name
+        row.ts = max(row.ts, ts)
+        # display state ends at the terminal execution states — RETURNED
+        # is a phase endpoint, not a TaskStatus (reference parity).
+        # Compared against the DISPLAYED state's rank, not row.rank: a
+        # RETURNED that outruns its FINISHED (driver flushed first) must
+        # not wedge the display at RUNNING. A FINISHED arriving after
+        # FAILED/CANCELLED (equal rank) DOES win: a retry that succeeded
+        # supersedes the failed attempt, and its stale error clears.
+        disp_rank = E.STATE_RANK.get(row.state, -1)
+        term_mono = row.state_mono.get(row.state)
+        if state == E.RUNNING and row.state in (E.FAILED, E.CANCELLED) \
+                and folded_mono is not None \
+                and (term_mono is None or folded_mono > term_mono):
+            # a RETRY started after a terminal attempt: re-open the
+            # timeline from this attempt's RUNNING (fresh stamps, error
+            # cleared, terminal/RETURNED stamps dropped so the retry's
+            # own completion re-terminates the row and the straggler
+            # detector can watch it — including re-flagging, so the
+            # first attempt's flag is reset too). Guarded by the
+            # monotonic comparison: a STALE first-attempt RUNNING whose
+            # flush was outrun by the owner's terminal stamp (events
+            # ride different connections) predates it in the folded
+            # timebase and must NOT destroy the terminal state — the
+            # fold stays commutative. Phases already observed into the
+            # histograms stay observed — each task contributes each
+            # phase at most once (first attempt wins), which keeps the
+            # exec distribution honest without per-attempt tracking.
+            row.state = E.RUNNING
+            row.error = ""
+            row.straggler = False
+            row.straggler_ms = 0.0
+            row.state_ts[E.RUNNING] = ts
+            row.state_mono.pop(E.RUNNING, None)
+            for st in (E.FINISHED, E.FAILED, E.CANCELLED, E.RETURNED):
+                row.state_ts.pop(st, None)
+                row.state_mono.pop(st, None)
+        elif state != E.RETURNED and (
+                rank > disp_rank
+                or (state == E.FINISHED
+                    and row.state in (E.FAILED, E.CANCELLED))):
+            row.state = state
+            if state == E.FINISHED:
+                row.error = ""
+        if state in (E.FETCHING_ARGS, E.RUNNING, E.FINISHED):
+            # the executing worker's identity wins over the submitter's
+            row.worker_id, row.node_idx = wid, nidx
+        elif state in (E.FAILED, E.CANCELLED) and \
+                E.FETCHING_ARGS not in row.state_ts and \
+                E.RUNNING not in row.state_ts:
+            # owner-side terminal stamps (worker crash, dep failure)
+            # must not clobber the identity of the worker that actually
+            # ran the task; they only fill it for never-dispatched tasks
+            row.worker_id, row.node_idx = wid, nidx
+        elif not row.worker_id:
+            row.worker_id, row.node_idx = wid, nidx
+        if err and row.state != E.FINISHED:
+            row.error = err
+        if trace_id and not row.trace_id:
+            row.trace_id = trace_id
+        row.state_ts.setdefault(state, ts)
+        if folded_mono is not None and state not in row.state_mono:
+            row.state_mono[state] = folded_mono
+            self._observe_new_phases(row)
+
+    def _observe_new_phases(self, row: _TaskTimeline):
+        """Histogram each phase exactly once, the moment both endpoints
+        are known (caller holds the lock)."""
+        from . import events as E
+
+        for ph, ms in E.derive_phase_ms(row.state_mono).items():
+            if ph in row.observed:
+                continue
+            if ph == "exec" and E.FINISHED not in row.state_mono:
+                # a FAILED/CANCELLED attempt's exec time must not seed
+                # the COMPLETED-exec baseline the straggler detector
+                # compares against (5 fast transient failures would arm
+                # a ~ms bound that flags every legitimate run). Not
+                # marked observed: if a retry re-opens and FINISHES,
+                # its exec observes then.
+                continue
+            row.observed.add(ph)
+            self._observe_phase_hist(
+                "task.phase_ms",
+                "Per-phase task lifecycle latency by function "
+                "(sched_wait/dispatch/arg_fetch/exec/result_return/e2e)",
+                {"func": row.name, "phase": ph}, ms)
+            if ph in ("dispatch", "arg_fetch") and row.node_idx >= 0:
+                # the phases that END on the executing node — the
+                # slow-node skew detector compares these across nodes
+                self._observe_phase_hist(
+                    "task.node_phase_ms",
+                    "Per-phase task lifecycle latency by executing node",
+                    {"node": str(row.node_idx), "phase": ph}, ms)
+
+    def _observe_phase_hist(self, name: str, desc: str, tags: Dict[str, str],
+                            value_ms: float):
+        """Head-side histogram observation straight into the merged
+        metric table (same row schema as _h_metrics_report ingests), so
+        the phase histograms ride metrics_summary() / the Prometheus
+        exposition (`task_phase_ms_bucket{func=...,phase=...}`) with no
+        extra plumbing. Caller holds the lock."""
+        key = (name, tuple(tags.values()))
+        row = self.metrics.get(key)
+        if row is None:
+            row = self.metrics[key] = {
+                "name": name, "kind": "histogram", "description": desc,
+                "tags": dict(tags),
+                "boundaries": list(TASK_PHASE_MS_BOUNDARIES),
+                "value": [0.0] * (len(TASK_PHASE_MS_BOUNDARIES) + 3),
+            }
+        v = row["value"]
+        for i, b in enumerate(TASK_PHASE_MS_BOUNDARIES):
+            if value_ms <= b:
+                v[i] += 1
+                break
+        else:
+            v[len(TASK_PHASE_MS_BOUNDARIES)] += 1
+        v[-2] += value_ms
+        v[-1] += 1
+
+    def _task_phase_summary(self) -> Dict[str, dict]:
+        """{func: {phase: {count, mean_ms, p50_ms, p95_ms, p99_ms}}}
+        from the folded phase histograms (caller holds the lock)."""
+        out: Dict[str, dict] = {}
+        for key, row in self.metrics.items():
+            if key[0] != "task.phase_ms":
+                continue
+            v, b = row["value"], row["boundaries"]
+            n = v[-1]
+            if n <= 0:
+                continue
+            out.setdefault(row["tags"]["func"], {})[
+                row["tags"]["phase"]] = {
+                "count": n,
+                "mean_ms": v[-2] / n,
+                "p50_ms": _hist_quantile(b, v, 0.50),
+                "p95_ms": _hist_quantile(b, v, 0.95),
+                "p99_ms": _hist_quantile(b, v, 0.99),
+            }
+        return out
+
+    def detect_stragglers(self):
+        """One detector sweep (the detector thread's body; callable
+        directly from tests). A RUNNING task whose current exec time
+        exceeds ``straggler_factor`` x its func's completed-exec p95
+        (min-sample-gated) is flagged once and emits ONE rate-limited
+        ``task_straggler`` cluster event naming task, node and worker;
+        per-node dispatch/arg_fetch p95 skew vs the cluster median emits
+        ``slow_node`` (>= 30s apart per node+phase)."""
+        from . import events as E
+
+        cfg = get_config()
+        now = time.monotonic()
+        flagged: List[tuple] = []
+        with self._lock:
+            for row in self.task_timelines.values():
+                if len(flagged) >= 10:
+                    # cap the event volume per sweep; the rest stay
+                    # UN-flagged and get their one event on a later
+                    # sweep (a mass stall's node-level signal is the
+                    # slow_node / node_dead path anyway)
+                    break
+                if row.straggler or row.state != E.RUNNING:
+                    continue
+                start = row.state_mono.get(E.RUNNING)
+                if start is None:
+                    continue
+                hist = self.metrics.get(("task.phase_ms",
+                                         (row.name, "exec")))
+                if hist is None or \
+                        hist["value"][-1] < cfg.straggler_min_samples:
+                    continue
+                v, nb = hist["value"], len(TASK_PHASE_MS_BOUNDARIES)
+                if sum(v[:nb]) < 0.95 * v[-1]:
+                    # the p95 falls in the +Inf bucket: the upper tail
+                    # is unknown (quantile would clamp to the last
+                    # finite bound and falsely flag EVERY run of a
+                    # func whose normal exec exceeds it) — no robust
+                    # bound exists, so don't flag
+                    continue
+                p95 = _hist_quantile(hist["boundaries"], hist["value"],
+                                     0.95)
+                bound_ms = max(p95, 1.0) * cfg.straggler_factor
+                running_ms = (now - start) * 1000.0
+                if running_ms > bound_ms:
+                    row.straggler = True
+                    row.straggler_ms = running_ms
+                    self.stragglers_flagged += 1
+                    flagged.append((row.task_id, row.name, row.worker_id,
+                                    row.node_idx, running_ms, p95))
+            slow_nodes = self._detect_slow_nodes(now)
+        # rate limit: the per-task flag means one event per straggler
+        # ever, and the sweep loop above caps flags per sweep
+        for tid, func, wid, nidx, running_ms, p95 in flagged:
+            self.emit_event(
+                "WARNING", "head", "task_straggler",
+                f"task {tid[:16]} ({func}) running {running_ms:.0f}ms on "
+                f"node {nidx}, over {get_config().straggler_factor:g}x "
+                f"its p95 exec ({p95:.0f}ms)",
+                node_idx=nidx, entity_id=tid,
+                extra={"task_id": tid, "func": func, "worker_id": wid,
+                       "node_idx": nidx, "running_ms": running_ms,
+                       "exec_p95_ms": p95})
+        for nidx, phase, p95, med in slow_nodes:
+            self.emit_event(
+                "WARNING", "head", "slow_node",
+                f"node {nidx} {phase} p95 {p95:.0f}ms vs cluster median "
+                f"{med:.0f}ms — host-level skew (slow NIC/disk/CPU?)",
+                node_idx=nidx,
+                extra={"node_idx": nidx, "phase": phase, "p95_ms": p95,
+                       "cluster_median_ms": med})
+
+    def _detect_slow_nodes(self, now: float) -> List[tuple]:
+        """Per-node phase-skew check (caller holds the lock): a node
+        whose dispatch/arg_fetch p95 is ``straggler_factor`` x the
+        cluster median (and at least 5ms over it — sub-ms noise never
+        alarms) is flagged, rate-limited per (node, phase)."""
+        cfg = get_config()
+        out: List[tuple] = []
+        for phase in ("dispatch", "arg_fetch"):
+            p95s: Dict[int, float] = {}
+            for key, row in self.metrics.items():
+                if key[0] != "task.node_phase_ms" or \
+                        row["tags"].get("phase") != phase:
+                    continue
+                if row["value"][-1] < cfg.straggler_min_samples:
+                    continue
+                try:
+                    nidx = int(row["tags"]["node"])
+                except ValueError:
+                    continue
+                node = self.nodes.get(nidx)
+                if node is None or not node.alive:
+                    continue  # stale histogram of a removed node
+                p95s[nidx] = _hist_quantile(row["boundaries"],
+                                            row["value"], 0.95)
+            if len(p95s) < 2:
+                continue
+            med = statistics.median(p95s.values())
+            for nidx, p95 in p95s.items():
+                if p95 > med * cfg.straggler_factor and p95 >= med + 5.0:
+                    last = self._last_slow_node_event.get((nidx, phase),
+                                                          -1e18)
+                    if now - last < 30.0:
+                        continue
+                    self._last_slow_node_event[(nidx, phase)] = now
+                    self.slow_nodes_flagged += 1
+                    out.append((nidx, phase, p95, med))
+        return out
+
+    def _straggler_loop(self):
+        period = get_config().straggler_detect_period_s
+        while not self._shutdown:
+            time.sleep(period)
+            try:
+                self.detect_stragglers()
+            except Exception:
+                if not self._shutdown:
+                    import traceback
+
+                    traceback.print_exc()
 
     # --------------------------------------------------- cluster events
 
@@ -2168,6 +2560,11 @@ class Head:
                     # last reporter-agent sample for this node (node.*
                     # gauges; empty until the first telemetry period)
                     "telemetry": dict(self.node_telemetry.get(n.idx, {})),
+                    # RTT-midpoint (agent_mono - head_mono) estimate used
+                    # to fold this node's event stamps (0 for local
+                    # nodes: CLOCK_MONOTONIC is host-wide)
+                    "clock_offset_s": n.clock_offset_s,
+                    "clock_rtt_s": n.clock_rtt_s,
                 } for n in self.nodes.values()]
             elif kind == "workers":
                 rows = [{
@@ -2286,25 +2683,69 @@ class Head:
                 } for (ts, sev, src, nidx, eid, etype, msg, extra)
                     in list(self.cluster_events)[-limit:]]
             elif kind == "task_events":
-                # raw transition log (timeline/tracing export)
+                # raw transition log (timeline/tracing export); tolerant
+                # of the pre-r10 10-field shape (no monotonic stamp)
                 rows = [{
-                    "task_id": tid, "name": name, "state": state,
-                    "worker_id": wid, "node_idx": nidx, "ts": ts,
-                    "error": err, "trace_id": tr, "span_id": sp,
-                    "parent_span_id": psp,
-                } for (tid, name, state, wid, nidx, ts, err, tr, sp, psp)
-                    in self.task_events]
+                    "task_id": ev[0], "name": ev[1], "state": ev[2],
+                    "worker_id": ev[3], "node_idx": ev[4], "ts": ev[5],
+                    "error": ev[6], "trace_id": ev[7], "span_id": ev[8],
+                    "parent_span_id": ev[9],
+                    "mono": ev[10] if len(ev) > 10 else None,
+                } for ev in self.task_events]
             elif kind == "tasks":
-                # newest state wins per task id; newest tasks first
-                latest: Dict[str, dict] = {}
-                for (tid, name, state, wid, nidx, ts, err, tr, sp, psp) \
-                        in self.task_events:
-                    latest[tid] = {
-                        "task_id": tid, "name": name, "state": state,
-                        "worker_id": wid, "node_idx": nidx,
-                        "ts": ts, "error": err, "trace_id": tr,
-                    }
-                rows = list(latest.values())[::-1]
+                # folded timelines, newest activity first: full state_ts
+                # map + derived per-phase latency breakdown per row.
+                # Materialize only `limit` rows — all of this runs under
+                # the head lock, and building 10k fat dicts per
+                # dashboard poll would stall the whole control plane.
+                from . import events as E
+
+                rows = []
+                for r in reversed(self.task_timelines.values()):
+                    if len(rows) >= limit:
+                        break
+                    rows.append({
+                        "task_id": r.task_id, "name": r.name,
+                        "state": r.state, "worker_id": r.worker_id,
+                        "node_idx": r.node_idx, "ts": r.ts,
+                        "error": r.error, "trace_id": r.trace_id,
+                        "state_ts": dict(r.state_ts),
+                        "phase_ms": E.derive_phase_ms(r.state_mono),
+                        "straggler": r.straggler,
+                    })
+            elif kind == "task_summary":
+                # per-func per-phase percentile summary from the folded
+                # phase histograms (`ray summary tasks` parity++), plus
+                # the (name, state) counts computed HERE — summarizing
+                # must not ship every fat timeline row over the RPC
+                # just to count states
+                counts: Dict[str, Dict[str, int]] = {}
+                for r in self.task_timelines.values():
+                    by_state = counts.setdefault(r.name, {})
+                    by_state[r.state] = by_state.get(r.state, 0) + 1
+                rows = [{
+                    "phases": self._task_phase_summary(),
+                    "stragglers_flagged": self.stragglers_flagged,
+                    "slow_nodes_flagged": self.slow_nodes_flagged,
+                    "total": len(self.task_timelines),
+                    "by_func_name": dict(sorted(counts.items())),
+                }]
+            elif kind == "slow_tasks":
+                from . import events as E
+
+                rows = []
+                for r in reversed(self.task_timelines.values()):
+                    if len(rows) >= limit:
+                        break
+                    if not r.straggler:
+                        continue
+                    rows.append({
+                        "task_id": r.task_id, "name": r.name,
+                        "state": r.state, "worker_id": r.worker_id,
+                        "node_idx": r.node_idx,
+                        "running_ms_when_flagged": r.straggler_ms,
+                        "phase_ms": E.derive_phase_ms(r.state_mono),
+                    })
             else:
                 conn.reply_error(rid, ValueError(f"unknown kind {kind!r}"))
                 return
@@ -2541,9 +2982,22 @@ class Head:
     def _ping_node(self, node: NodeState):
         cfg = get_config()
         try:
-            node.agent_conn.call(
+            t0 = time.monotonic()
+            reply = node.agent_conn.call(
                 P.PING, timeout=max(cfg.health_check_period_s, 1.0))
+            t1 = time.monotonic()
             node.health_failures = 0
+            # Heartbeat doubles as the clock-offset sampler: agents reply
+            # with their own monotonic clock; the RTT midpoint estimates
+            # (agent_mono - head_mono), refreshed every probe so drift
+            # stays bounded. Folded task-event stamps from this node have
+            # the offset subtracted (phase math in one timebase).
+            if len(reply) >= 2 and isinstance(reply[1], (int, float)):
+                off = float(reply[1]) - (t0 + t1) / 2.0
+                with self._lock:
+                    node.clock_offset_s = off
+                    node.clock_rtt_s = t1 - t0
+                    self.node_clock_offsets[node.idx] = off
         except Exception:  # noqa: BLE001 — timeout or conn error
             node.health_failures += 1
             if node.health_failures >= \

@@ -197,7 +197,12 @@ class NodeAgent:
                 for ob in msg[2]:
                     self.store.delete(ObjectID(ob))
             elif mt == P.PING:
-                conn.reply(rid, True)
+                # health probe doubles as the clock-offset sampler: the
+                # head takes the RTT midpoint of this call against our
+                # monotonic clock to fold this host's task-event stamps
+                # into its own timebase (wall clock rides along for
+                # display-only diagnostics)
+                conn.reply(rid, True, time.monotonic(), time.time())
         except Exception as e:  # noqa: BLE001
             if rid > 0:
                 conn.reply_error(rid, e)

@@ -196,6 +196,36 @@ DOCTOR_ENDPOINTS = (
 )
 
 
+def doctor_warnings() -> list:
+    """Health warnings that are not endpoint failures: nonzero
+    ``task_events_dropped`` / ``cluster_events_dropped`` mean the
+    bounded event buffers overflowed — the task timelines and event log
+    are silently missing transitions, which blinds the phase breakdown
+    and straggler detector. Returns human-readable warning strings
+    (empty on a healthy cluster)."""
+    from ray_tpu import state
+
+    warns = []
+    try:
+        rows = state.io_loop_stats()
+    except Exception:  # noqa: BLE001 — no cluster up: nothing to warn on
+        return warns
+    for row in rows:
+        td = row.get("task_events_dropped", 0)
+        cd = row.get("cluster_events_dropped", 0)
+        if td:
+            warns.append(
+                f"task_events_dropped={td}: task timelines are missing "
+                "transitions (phase breakdowns / straggler detection are "
+                "unreliable) — raise task_event_buffer_size")
+        if cd:
+            warns.append(
+                f"cluster_events_dropped={cd}: the cluster event log "
+                "overflowed and lost records — raise "
+                "cluster_event_buffer_size")
+    return warns
+
+
 def doctor(verbose: bool = False) -> list:
     """Dashboard endpoint smoke check (``python -m ray_tpu doctor``):
     boots a 2-node local cluster when no runtime is up, runs a task so
@@ -239,6 +269,11 @@ def doctor(verbose: bool = False) -> list:
                 print(f"  [{mark}] {row['status'] or '---'} {ep}"
                       + (f"  {row['error']}" if row["error"] else ""))
             results.append(row)
+        if verbose:
+            # programmatic callers use doctor_warnings() directly; the
+            # CLI (doctor verbose=True) surfaces them here
+            for warn in doctor_warnings():
+                print(f"  [warn] {warn}")
     finally:
         if dash is not None:
             dash.stop()

@@ -159,11 +159,23 @@ const RENDER = {
   async tasks() {
     const [rows, sum] = await Promise.all([j("/api/tasks"),
                                            j("/api/summary/tasks")]);
-    const states = Object.entries(sum.by_state || {})
-      .map(([k, v]) => [k, v]);
+    const byState = {};
+    for (const counts of Object.values(sum.by_func_name || {}))
+      for (const [st, n] of Object.entries(counts))
+        byState[st] = (byState[st] || 0) + n;
+    const states = Object.entries(byState);
+    const enriched = rows.map(r => {
+      const ph = r.phase_ms || {};
+      const f = v => v === undefined ? "" : v.toFixed(1);
+      return {...r, sched_wait_ms: f(ph.sched_wait),
+              arg_fetch_ms: f(ph.arg_fetch), exec_ms: f(ph.exec),
+              e2e_ms: f(ph.e2e),
+              straggler: r.straggler ? "STRAGGLER" : ""};
+    });
     return `<h2>tasks</h2>` + (states.length ? tiles(states) : "") +
-      table(rows, ["task_id","name","state","node_idx","worker_id",
-                   "duration_ms"], ["state"]);
+      table(enriched, ["task_id","name","state","node_idx","worker_id",
+                   "sched_wait_ms","arg_fetch_ms","exec_ms","e2e_ms",
+                   "straggler"], ["state"]);
   },
   async objects() {
     return `<h2>objects</h2>` + table(await j("/api/objects"),

@@ -318,25 +318,74 @@ def _label_str(tags: Dict[str, str]) -> str:
                     for k, v in tags.items())
 
 
+def _escape_help(text: str) -> str:
+    """HELP-line escaping per the exposition spec: backslash and
+    line-feed only (double quotes are legal in HELP text)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+_NAME_SANITIZE = None  # compiled lazily (module import stays cheap)
+
+
+def _metric_name(raw: str) -> str:
+    """Sanitize to the spec's metric-name charset
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*`` (dotted internal names like
+    ``task.phase_ms`` become ``task_phase_ms``)."""
+    global _NAME_SANITIZE
+    if _NAME_SANITIZE is None:
+        import re
+
+        _NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+    name = _NAME_SANITIZE.sub("_", raw)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
 def export_prometheus() -> str:
     """Render the head's metric table in Prometheus text exposition
-    format (the reference exports via opencensus -> prometheus)."""
-    lines: List[str] = []
+    format (the reference exports via opencensus -> prometheus).
+
+    Spec conformance (audited against the text-format spec, and parsed
+    by a unit test): one ``# HELP``/``# TYPE`` header per metric family
+    before any of its samples; histogram bucket counts are CUMULATIVE,
+    always include the mandatory ``le="+Inf"`` bucket (whose value
+    equals ``_count``), and every family ships its ``_sum``/``_count``
+    series; label values escape backslash/quote/newline; metric names
+    sanitize to the legal charset."""
+    families: Dict[str, List[dict]] = {}
+    order: List[str] = []
     for row in metrics_summary():
-        name = row["name"].replace(".", "_")
-        tags = row["tags"]
-        label = _label_str(tags)
-        label = "{" + label + "}" if label else ""
-        if row["kind"] == "histogram":
-            h = row["value"]
-            bounds = row["boundaries"]
-            acc = 0.0
-            for b, c in zip(list(bounds) + ["+Inf"], h[:-2]):
-                acc += c
-                ls = _label_str(dict(tags, le=str(b)))
-                lines.append(f"{name}_bucket{{{ls}}} {acc:g}")
-            lines.append(f"{name}_sum{label} {h[-2]:g}")
-            lines.append(f"{name}_count{label} {h[-1]:g}")
-        else:
-            lines.append(f"{name}{label} {row['value']:g}")
+        name = _metric_name(row["name"])
+        if name not in families:
+            families[name] = []
+            order.append(name)
+        families[name].append(row)
+    lines: List[str] = []
+    for name in order:
+        rows = families[name]
+        kind = rows[0]["kind"]
+        ptype = {"counter": "counter", "gauge": "gauge",
+                 "histogram": "histogram"}.get(kind, "untyped")
+        desc = next((r["description"] for r in rows
+                     if r.get("description")), "")
+        if desc:
+            lines.append(f"# HELP {name} {_escape_help(desc)}")
+        lines.append(f"# TYPE {name} {ptype}")
+        for row in rows:
+            tags = row["tags"]
+            label = _label_str(tags)
+            label = "{" + label + "}" if label else ""
+            if row["kind"] == "histogram":
+                h = row["value"]
+                bounds = row["boundaries"]
+                acc = 0.0
+                for b, c in zip(list(bounds) + ["+Inf"], h[:-2]):
+                    acc += c
+                    ls = _label_str(dict(tags, le=str(b)))
+                    lines.append(f"{name}_bucket{{{ls}}} {acc:g}")
+                lines.append(f"{name}_sum{label} {h[-2]:g}")
+                lines.append(f"{name}_count{label} {h[-1]:g}")
+            else:
+                lines.append(f"{name}{label} {row['value']:g}")
     return "\n".join(lines) + "\n"

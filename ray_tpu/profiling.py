@@ -36,11 +36,35 @@ def _profile_dir(session_dir: str) -> str:
     return d
 
 
+# This module's own file: any sampled frame living here is profiler
+# machinery (the SIGUSR1 handler interrupting user code, a concurrent
+# request's sampler thread, profile_self's runner) — not user work, and
+# it must not pollute the folded stacks (a flamegraph whose widest box
+# is `collect_stacks` is measuring the measurement).
+_THIS_FILE = os.path.abspath(__file__)
+# memoized per raw co_filename string: the sampler visits every frame of
+# every thread at every tick, and an abspath() per frame would be
+# measurable self-overhead inside the very loop being profiled
+_is_profiler_file: Dict[str, bool] = {}
+
+
+def _profiler_frame(filename: str) -> bool:
+    hit = _is_profiler_file.get(filename)
+    if hit is None:
+        hit = _is_profiler_file[filename] = (
+            filename == __file__
+            or os.path.abspath(filename) == _THIS_FILE)
+    return hit
+
+
 def collect_stacks(duration_s: float, hz: float,
                    skip_thread: Optional[int] = None) -> Dict[str, int]:
     """Sample every thread's stack for ``duration_s`` at ``hz``;
     -> {folded_stack: count}. Runs in-process (the sampler itself is
-    excluded via ``skip_thread``)."""
+    excluded via ``skip_thread``; frames belonging to this module —
+    signal handler, concurrent samplers — are filtered out of every
+    stack, and a stack that was NOTHING but profiler frames is dropped
+    entirely)."""
     counts: "collections.Counter[str]" = collections.Counter()
     period = 1.0 / max(hz, 1.0)
     end = time.monotonic() + duration_s
@@ -52,11 +76,13 @@ def collect_stacks(duration_s: float, hz: float,
             f = frame
             while f is not None:
                 code = f.f_code
-                parts.append(f"{code.co_name} "
-                             f"({os.path.basename(code.co_filename)}:"
-                             f"{f.f_lineno})")
+                if not _profiler_frame(code.co_filename):
+                    parts.append(f"{code.co_name} "
+                                 f"({os.path.basename(code.co_filename)}:"
+                                 f"{f.f_lineno})")
                 f = f.f_back
-            counts[";".join(reversed(parts))] += 1
+            if parts:
+                counts[";".join(reversed(parts))] += 1
         time.sleep(period)
     return dict(counts)
 
@@ -99,10 +125,23 @@ def _run_request(session_dir: str, worker_id: str):
     out = {"worker_id": worker_id, "pid": os.getpid(),
            "duration_s": req.get("duration_s", 1.0),
            "samples": sum(stacks.values()), "stacks": stacks}
-    tmp = os.path.join(d, f".{worker_id}.stacks.tmp")
-    with open(tmp, "w") as f:
-        json.dump(out, f)
-    os.replace(tmp, os.path.join(d, f"{worker_id}.stacks.json"))
+    # per-request tmp name: two concurrent requests for the same worker
+    # (double SIGUSR1 / racing /api/profile callers) must never
+    # interleave writes into one tmp file — each writes its own and the
+    # atomic replace makes the published .stacks.json always a complete
+    # document (last writer wins)
+    tmp = os.path.join(
+        d, f".{worker_id}.{os.getpid()}.{threading.get_ident()}"
+           ".stacks.tmp")
+    try:
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+        os.replace(tmp, os.path.join(d, f"{worker_id}.stacks.json"))
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass  # already replaced (the normal path)
 
 
 # ---------------------------------------------------------------- caller side

@@ -195,6 +195,7 @@ def cmd_list(args):
         "objects": state_api.list_objects,
         "placement-groups": state_api.list_placement_groups,
         "cluster-events": state_api.list_cluster_events,
+        "slow-tasks": state_api.list_slow_tasks,
     }[args.entity]
     _attached(args)
     rows = fn(limit=args.limit)
@@ -247,7 +248,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("entity", choices=["nodes", "workers", "actors",
                                        "tasks", "objects",
                                        "placement-groups",
-                                       "cluster-events"])
+                                       "cluster-events", "slow-tasks"])
     sp.add_argument("--address")
     sp.add_argument("--limit", type=int, default=100)
     sp.set_defaults(fn=cmd_list)

@@ -70,9 +70,26 @@ def list_objects(filters=None, limit: int = _DEFAULT_LIMIT):
 
 
 def list_tasks(filters=None, limit: int = _DEFAULT_LIMIT):
-    """Ref parity: ray.util.state.list_tasks — latest state per task id,
-    newest first, from the head's task-event ring buffer."""
+    """Ref parity: ray.util.state.list_tasks — one folded timeline row
+    per task id, newest activity first. Beyond the reference's columns,
+    each row carries ``state_ts`` (state -> wall timestamp of every
+    lifecycle transition: SUBMITTED, PENDING_ARGS_AVAIL,
+    PENDING_NODE_ASSIGNMENT, SUBMITTED_TO_WORKER, FETCHING_ARGS,
+    RUNNING, FINISHED/FAILED, RETURNED), ``phase_ms`` (derived
+    sched_wait / dispatch / arg_fetch / exec / result_return / e2e
+    durations, computed from monotonic stamps folded through per-node
+    clock offsets and clamped >= 0), and ``straggler`` (set by the
+    head's detector when the task ran past its func's robust exec
+    bound)."""
     return _apply_filters(_query("tasks", limit), filters)
+
+
+def list_slow_tasks(filters=None, limit: int = _DEFAULT_LIMIT):
+    """Tasks the head's straggler detector flagged: each row carries the
+    task/node/worker ids, ``running_ms_when_flagged``, and the phase
+    breakdown known so far. A flagged task stays listed after it
+    (eventually) finishes — filter on ``state`` for live ones."""
+    return _apply_filters(_query("slow_tasks", limit), filters)
 
 
 def list_cluster_events(filters=None, limit: int = 1000):
@@ -108,14 +125,23 @@ def io_loop_stats() -> List[Dict[str, Any]]:
 
 def summarize_tasks(limit: int = 10_000) -> Dict[str, Any]:
     """Ref parity: ray.util.state.summarize_tasks (api.py:1009): count of
-    tasks by (name, state)."""
-    rows = list_tasks(limit=limit)
-    by_func: Dict[str, Counter] = {}
-    for r in rows:
-        by_func.setdefault(r["name"], Counter())[r["state"]] += 1
+    tasks by (name, state) — extended with ``phases``: per-func-name
+    p50/p95/p99/mean latency per lifecycle phase (sched_wait / dispatch /
+    arg_fetch / exec / result_return / e2e), estimated from the head's
+    ``task.phase_ms{func,phase}`` histograms (the `ray summary tasks`
+    "where does task time go" answer), plus the detector's cumulative
+    straggler / slow-node flag counts. Everything aggregates head-side
+    over the full folded-timeline table (one small RPC — no fat rows
+    ship just to be counted; ``limit`` is kept for API compatibility)."""
+    del limit  # aggregation is head-side over all folded timelines
+    summary = _query("task_summary", 1)
+    s = summary[0] if summary else {}
     return {
-        "total": len(rows),
-        "by_func_name": {k: dict(v) for k, v in sorted(by_func.items())},
+        "total": s.get("total", 0),
+        "by_func_name": s.get("by_func_name", {}),
+        "phases": s.get("phases", {}),
+        "stragglers_flagged": s.get("stragglers_flagged", 0),
+        "slow_nodes_flagged": s.get("slow_nodes_flagged", 0),
     }
 
 

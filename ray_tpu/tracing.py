@@ -71,8 +71,12 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
 
     Task RUNNING->FINISHED/FAILED pairs and span START->END pairs become
     complete ("X") events; pid = node, tid = worker; args carry the
-    trace/span ids for traced events. Returns the event list; also
-    writes JSON when ``filename`` is given."""
+    trace/span ids for traced events. Every task with lifecycle stamps
+    additionally gets per-phase sub-slices (cat "phase": sched_wait /
+    dispatch / arg_fetch / exec / result_return) laid in the lane of the
+    process that ended the phase — the "where does task time go" view,
+    zoomable in Perfetto. Returns the event list; also writes JSON when
+    ``filename`` is given."""
     ctx = get_context()
     # flush-ack: the head replies only after ingesting the batch, so the
     # STATE_QUERY below is ordered after ingestion (no sleep, no race —
@@ -83,8 +87,12 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
                             timeout=30)
     open_at: Dict[str, dict] = {}
     events: List[Dict[str, Any]] = []
+    # per-task first-occurrence of each lifecycle state, for sub-slices
+    lifecycle: Dict[str, Dict[str, dict]] = {}
     for r in sorted(rows, key=lambda r: r["ts"]):
         state = r["state"]
+        if state in _ev.STATE_RANK:
+            lifecycle.setdefault(r["task_id"], {}).setdefault(state, r)
         if state in ("RUNNING", SPAN_START):
             open_at[r["task_id"]] = r
         elif state in ("FINISHED", "FAILED", SPAN_END):
@@ -107,6 +115,31 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
                 "pid": f"node{start['node_idx']}",
                 "tid": f"worker:{start['worker_id'][:8]}",
                 "args": args,
+            })
+    # per-phase sub-slices from the shared events.PHASE_BOUNDS table
+    # (wall-clock laid out for display; the exact monotonic-clock
+    # durations live in list_tasks()'s phase_ms). e2e is skipped — it
+    # would just shadow the whole row.
+    for tid, states in lifecycle.items():
+        for phase, a_states, b_states in _ev.PHASE_BOUNDS:
+            if phase == "e2e":
+                continue
+            a = next((states[s] for s in a_states if s in states), None)
+            b = next((states[s] for s in b_states if s in states), None)
+            if a is None or b is None:
+                continue
+            events.append({
+                "name": f"{b['name']}:{phase}",
+                "cat": "phase",
+                "ph": "X",
+                "ts": a["ts"] * 1e6,
+                "dur": max(b["ts"] - a["ts"], 0) * 1e6,
+                # the lane of the process that ENDED the phase (the
+                # worker for dispatch/arg_fetch/exec, the driver for
+                # sched_wait/result_return)
+                "pid": f"node{b['node_idx']}",
+                "tid": f"worker:{b['worker_id'][:8]}",
+                "args": {"task_id": tid, "phase": phase},
             })
     if filename:
         with open(filename, "w") as f:

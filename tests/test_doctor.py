@@ -50,6 +50,73 @@ def test_doctor_reports_500(ray_start, monkeypatch):
     assert by_ep["/api/nodes"]["ok"]
 
 
+def test_doctor_warns_on_event_drops(ray_start):
+    """Nonzero task/cluster event drop counters silently blind the task
+    timelines — the doctor must warn about them."""
+    from ray_tpu import dashboard as dash_mod
+    from ray_tpu.core.api import _head
+
+    assert dash_mod.doctor_warnings() == []
+    maxlen = _head.cluster_events.maxlen
+    for n in range(maxlen + 3):
+        _head.emit_event("INFO", "test", "filler", f"event {n}")
+    warns = dash_mod.doctor_warnings()
+    assert any("cluster_events_dropped" in w for w in warns), warns
+    tmax = _head.task_events.maxlen
+    batch = [(f"t{n}", "x", "RUNNING", "w", 0, 0.0, "", "", "", "")
+             for n in range(tmax + 2)]
+    _head._h_task_events(None, 0, batch, 0)
+    warns = dash_mod.doctor_warnings()
+    assert any("task_events_dropped" in w for w in warns), warns
+
+
+def test_summary_tasks_phase_percentiles_smoke(ray_start):
+    """Tier-1 CI smoke: after a short 2-node workload,
+    /api/summary/tasks reports per-phase p50/p95/p99 and /metrics
+    contains the task_phase_ms_bucket histogram series."""
+    import time
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu.core.api import _head
+    from ray_tpu.core.context import get_context
+    from ray_tpu.dashboard import start_dashboard
+
+    _head.add_node(num_cpus=1, num_tpus=0)
+
+    @ray_tpu.remote
+    def phase_probe(i):
+        return i
+
+    ray_tpu.get([phase_probe.remote(i) for i in range(6)], timeout=60)
+    get_context().events.flush(sync=True)
+    want = {"sched_wait", "dispatch", "arg_fetch", "exec",
+            "result_return", "e2e"}
+    dash = start_dashboard(port=0)
+    try:
+        deadline = time.monotonic() + 20
+        phases = {}
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(dash.url + "/api/summary/tasks",
+                                        timeout=30) as r:
+                summ = json.loads(r.read())
+            phases = summ.get("phases", {}).get("phase_probe", {})
+            if want <= set(phases):
+                break
+            time.sleep(0.3)  # worker event buffers flush on a 1s period
+        assert want <= set(phases), phases
+        for row in phases.values():
+            assert row["count"] >= 6
+            assert 0 <= row["p50_ms"] <= row["p95_ms"] <= row["p99_ms"]
+        with urllib.request.urlopen(dash.url + "/metrics",
+                                    timeout=30) as r:
+            text = r.read().decode()
+        assert "task_phase_ms_bucket" in text
+        assert 'phase="exec"' in text
+    finally:
+        dash.stop()
+
+
 def test_cluster_events_endpoint_shape(ray_start):
     """/api/cluster_events serves the structured log as JSON."""
     import urllib.request

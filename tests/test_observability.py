@@ -383,6 +383,336 @@ def test_worker_oom_kill_event(ray_start):
     ray_tpu.cancel(ref)
 
 
+def test_task_phase_breakdown_two_nodes():
+    """Real 2-node run: every lifecycle phase appears in list_tasks
+    rows with plausible ordering, all phases are >= 0 and their parts
+    sum to ~e2e, the per-func percentile summary fills in, and the
+    remote node's clock offset is exposed by list_nodes."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.context import get_context
+
+    cluster = Cluster(initialize_head=True, head_node_args={
+        "num_cpus": 2, "num_tpus": 0})
+    handle = None
+    want = {"sched_wait", "dispatch", "arg_fetch", "exec",
+            "result_return", "e2e"}
+    try:
+        handle = cluster.add_remote_node(num_cpus=2)
+
+        @ray_tpu.remote
+        def two_node_work(x):
+            time.sleep(0.02)
+            return x * 2
+
+        ray_tpu.get([two_node_work.remote(i) for i in range(8)],
+                    timeout=120)
+        get_context().events.flush(sync=True)
+        deadline = time.monotonic() + 20
+        rows = []
+        while time.monotonic() < deadline:
+            rows = [r for r in state.list_tasks(limit=1000)
+                    if r["name"] == "two_node_work"]
+            if len(rows) == 8 and all(
+                    r["state"] == "FINISHED"
+                    and want <= set(r["phase_ms"]) for r in rows):
+                break
+            time.sleep(0.3)
+        assert len(rows) == 8
+        for r in rows:
+            ph = r["phase_ms"]
+            assert want <= set(ph), ph
+            assert all(v >= 0.0 for v in ph.values()), ph
+            # the five sub-phases tile SUBMITTED->RETURNED up to the
+            # tiny submit->queue gap and clock-fold jitter
+            parts = (ph["sched_wait"] + ph["dispatch"] + ph["arg_fetch"]
+                     + ph["exec"] + ph["result_return"])
+            assert parts <= ph["e2e"] + 100.0, ph
+            assert ph["e2e"] >= ph["exec"] >= 15.0, ph
+            ts = r["state_ts"]
+            assert ts["SUBMITTED"] <= ts["SUBMITTED_TO_WORKER"] + 1e-6
+            assert ts["FETCHING_ARGS"] <= ts["RUNNING"] + 1e-6
+            assert ts["RUNNING"] <= ts["FINISHED"] + 1e-6
+        # per-func percentile summary (the `ray summary tasks` answer)
+        summ = state.summarize_tasks()
+        phases = summ["phases"]["two_node_work"]
+        assert want <= set(phases)
+        for row in phases.values():
+            assert row["count"] >= 8
+            assert 0 <= row["p50_ms"] <= row["p95_ms"] <= row["p99_ms"]
+        # the remote node advertises its measured clock offset (same
+        # physical host here, so the estimate must be near zero)
+        nodes = state.list_nodes()
+        assert any(n["is_remote"] for n in nodes)
+        for n in nodes:
+            assert "clock_offset_s" in n
+            if n["is_remote"]:
+                assert abs(n["clock_offset_s"]) < 1.0
+    finally:
+        if handle is not None:
+            handle.terminate()
+        cluster.shutdown()
+
+
+def test_straggler_detection_chaos(ray_start):
+    """Chaos: an artificially delayed task must trigger exactly ONE
+    rate-limited task_straggler cluster event naming the task, node and
+    worker, and appear in list_slow_tasks()."""
+    @ray_tpu.remote
+    def stall(t):
+        time.sleep(t)
+        return t
+
+    # build the func's completed-exec distribution past the min-sample
+    # gate (straggler_min_samples defaults to 5)
+    ray_tpu.get([stall.remote(0.02) for _ in range(8)], timeout=60)
+    ref = stall.remote(30)  # the straggler; reaped at fixture shutdown
+    deadline = time.monotonic() + 30
+    evs = []
+    while time.monotonic() < deadline:
+        evs = state.list_cluster_events(
+            filters=[("type", "=", "task_straggler")])
+        if evs:
+            break
+        time.sleep(0.3)
+    assert len(evs) == 1, evs
+    assert evs[0]["severity"] == "WARNING"
+    extra = evs[0]["extra"]
+    assert extra["func"] == "stall"
+    assert extra["task_id"] and extra["worker_id"]
+    assert extra["node_idx"] >= 0
+    assert extra["running_ms"] > extra["exec_p95_ms"]
+    slow = state.list_slow_tasks()
+    assert any(r["task_id"] == extra["task_id"] for r in slow)
+    # rate-limited: more detector sweeps must NOT re-emit for this task
+    time.sleep(2.5)
+    evs = state.list_cluster_events(
+        filters=[("type", "=", "task_straggler")])
+    assert len(evs) == 1, evs
+    del ref
+
+
+def test_clock_offset_fold_no_negative_phases(ray_start):
+    """Unit: events from a node whose monotonic clock runs far ahead
+    fold through the recorded per-node offset — every phase lands near
+    truth (not at the skew) and none goes negative."""
+    from ray_tpu.core import events as ev
+    from ray_tpu.core.api import _head
+
+    skew = 5000.0  # the fake agent's clock runs 5000s ahead of the head
+    _head.node_clock_offsets[42] = skew
+    base, wall = time.monotonic(), time.time()
+    tid = "f" * 32
+
+    def e(st, nidx, mono, dt=0.0):
+        return (tid, "skewed_fn", st, "w", nidx, wall + dt,
+                "", "", "", "", mono)
+
+    _head._h_task_events(None, 0, [
+        e(ev.SUBMITTED, 0, base),
+        e(ev.PENDING_NODE_ASSIGNMENT, 0, base + 0.001),
+        e(ev.SUBMITTED_TO_WORKER, 0, base + 0.011),
+        e(ev.FETCHING_ARGS, 42, base + skew + 0.021),
+        e(ev.RUNNING, 42, base + skew + 0.026),
+        e(ev.FINISHED, 42, base + skew + 0.126),
+        e(ev.RETURNED, 0, base + 0.141),
+    ], 0)
+    row = next(r for r in state.list_tasks(limit=1000)
+               if r["task_id"] == tid)
+    ph = row["phase_ms"]
+    assert set(ph) == {"sched_wait", "dispatch", "arg_fetch", "exec",
+                       "result_return", "e2e"}
+    assert all(v >= 0.0 for v in ph.values()), ph
+    assert abs(ph["dispatch"] - 10.0) < 1.0, ph
+    assert abs(ph["exec"] - 100.0) < 1.0, ph
+    assert abs(ph["e2e"] - 141.0) < 1.0, ph
+    assert row["state"] == "FINISHED"
+    # residual skew after the offset fold clamps at zero, never negative
+    assert ev.derive_phase_ms(
+        {ev.RUNNING: 10.0, ev.FINISHED: 9.999})["exec"] == 0.0
+
+
+def test_slow_node_skew_event(ray_start):
+    """One node's arg_fetch p95 far above the cluster median fires a
+    rate-limited slow_node event naming the node and phase (only LIVE
+    nodes are compared — stale histograms of removed nodes are
+    ignored)."""
+    from ray_tpu.core.api import _head
+
+    _head.add_node(num_cpus=1, num_tpus=0)  # nodes 0,1,2 live
+    _head.add_node(num_cpus=1, num_tpus=0)
+    with _head._lock:
+        for _ in range(10):
+            for node, ms in (("0", 4.0), ("1", 4.0), ("2", 800.0)):
+                _head._observe_phase_hist(
+                    "task.node_phase_ms", "test",
+                    {"node": node, "phase": "arg_fetch"}, ms)
+    _head.detect_stragglers()
+    evs = state.list_cluster_events(filters=[("type", "=", "slow_node")])
+    assert evs, "no slow_node event"
+    assert evs[0]["node_idx"] == 2
+    assert evs[0]["extra"]["phase"] == "arg_fetch"
+    assert evs[0]["extra"]["p95_ms"] > evs[0]["extra"]["cluster_median_ms"]
+    # rate-limited per (node, phase): an immediate re-sweep is silent
+    _head.detect_stragglers()
+    assert len(state.list_cluster_events(
+        filters=[("type", "=", "slow_node")])) == len(evs)
+
+
+def test_terminal_fold_owner_failures_and_retries(ray_start):
+    """Owner-side task death folds a terminal FAILED (never wedging the
+    timeline at RUNNING, which would feed false stragglers) without
+    clobbering the executing worker's identity; CANCELLED is a terminal
+    display state; and a retry that succeeds supersedes the earlier
+    FAILED attempt, clearing its stale error."""
+    from ray_tpu.core import events as ev
+    from ray_tpu.core.api import _head
+
+    base, wall = time.monotonic(), time.time()
+    tid = "a" * 32
+    _head._h_task_events(None, 0, [
+        (tid, "crashy", ev.RUNNING, "wkr", 0, wall, "", "", "", "", base),
+        # the owner's stamp after the worker crashed (context.py
+        # _complete_task_error): different recorder, carries the error
+        (tid, "crashy", ev.FAILED, "drv", 0, wall + 1,
+         "WorkerCrashedError('worker died')", "", "", "", base + 1),
+    ], 0)
+    row = next(r for r in state.list_tasks(limit=1000)
+               if r["task_id"] == tid)
+    assert row["state"] == "FAILED"
+    assert row["worker_id"] == "wkr", "executing worker identity lost"
+    assert "WorkerCrashedError" in row["error"]
+    # a FAILED attempt's exec time must NOT seed the completed-exec
+    # histogram the straggler detector baselines against
+    with _head._lock:
+        assert ("task.phase_ms", ("crashy", "exec")) not in _head.metrics
+    # a later FINISHED (successful retry) supersedes the failed attempt
+    _head._h_task_events(None, 0, [
+        (tid, "crashy", ev.FINISHED, "wkr2", 0, wall + 2,
+         "", "", "", "", base + 2),
+    ], 0)
+    row = next(r for r in state.list_tasks(limit=1000)
+               if r["task_id"] == tid)
+    assert row["state"] == "FINISHED" and row["error"] == ""
+    # worker-side CANCELLED is terminal too (not stuck at FETCHING_ARGS)
+    tid2 = "b" * 32
+    _head._h_task_events(None, 0, [
+        (tid2, "cxl", ev.FETCHING_ARGS, "w", 0, wall, "", "", "", "",
+         base),
+        (tid2, "cxl", ev.CANCELLED, "w", 0, wall + 0.1, "", "", "", "",
+         base + 0.1),
+    ], 0)
+    row = next(r for r in state.list_tasks(limit=1000)
+               if r["task_id"] == tid2)
+    assert row["state"] == "CANCELLED"
+    # a retry's RUNNING after a terminal attempt RE-OPENS the timeline
+    # (fresh RUNNING stamp, error cleared) so a hung retry is visible
+    # to the straggler detector instead of masquerading as FAILED
+    tid3 = "c" * 32
+    _head._h_task_events(None, 0, [
+        (tid3, "flaky", ev.RUNNING, "w1", 0, wall, "", "", "", "", base),
+        (tid3, "flaky", ev.FAILED, "w1", 0, wall + 1,
+         "ValueError('transient')", "", "", "", base + 1),
+    ], 0)
+    with _head._lock:  # first attempt got flagged before it failed
+        _head.task_timelines[tid3].straggler = True
+    _head._h_task_events(None, 0, [
+        (tid3, "flaky", ev.RUNNING, "w2", 0, wall + 2, "", "", "", "",
+         base + 2),
+    ], 0)
+    row = next(r for r in state.list_tasks(limit=1000)
+               if r["task_id"] == tid3)
+    assert row["state"] == "RUNNING" and row["error"] == ""
+    assert row["state_ts"]["RUNNING"] == wall + 2  # the retry's stamp
+    assert "FAILED" not in row["state_ts"]
+    assert not row["straggler"]  # re-armed: a hung retry can re-flag
+    # ...but a STALE first-attempt RUNNING whose flush was outrun by the
+    # owner's terminal stamp (older monotonic clock) must NOT re-open —
+    # the worker is dead, nothing would ever re-terminate the row
+    tid4 = "e" * 32
+    _head._h_task_events(None, 0, [
+        (tid4, "late", ev.FAILED, "drv", 0, wall + 1,
+         "WorkerCrashedError('worker died')", "", "", "", base + 1),
+        (tid4, "late", ev.RUNNING, "wkr", 0, wall, "", "", "", "", base),
+    ], 0)
+    row = next(r for r in state.list_tasks(limit=1000)
+               if r["task_id"] == tid4)
+    assert row["state"] == "FAILED"
+    assert "WorkerCrashedError" in row["error"]
+
+
+def test_straggler_gate_unknown_upper_tail(ray_start):
+    """A func whose completed execs land in the +Inf histogram bucket
+    has no known p95 — the detector must NOT flag its runs (the clamped
+    quantile would mark every normal multi-minute run a straggler)."""
+    from ray_tpu.core import events as ev
+    from ray_tpu.core.head import TASK_PHASE_MS_BOUNDARIES
+    from ray_tpu.core.api import _head
+
+    huge = TASK_PHASE_MS_BOUNDARIES[-1] * 2  # past the last bucket
+    with _head._lock:
+        for _ in range(10):
+            _head._observe_phase_hist(
+                "task.phase_ms", "t", {"func": "long_step",
+                                       "phase": "exec"}, huge)
+    base, wall = time.monotonic(), time.time()
+    tid = "d" * 32
+    _head._h_task_events(None, 0, [
+        (tid, "long_step", ev.RUNNING, "w", 0, wall, "", "", "", "",
+         base - 1000.0),  # "running for 1000s already"
+    ], 0)
+    _head.detect_stragglers()
+    row = next(r for r in state.list_tasks(limit=1000)
+               if r["task_id"] == tid)
+    assert not row["straggler"]
+    assert not any(r["task_id"] == tid for r in state.list_slow_tasks())
+
+
+def test_prometheus_exposition_parses_per_spec(ray_start):
+    """Audit satellite: the exposition must carry # HELP/# TYPE headers
+    before each family's samples, cumulative bucket counts ending in the
+    mandatory le="+Inf" bucket equal to _count, and _sum/_count series —
+    verified by parsing the output."""
+    import re
+
+    h = metrics.Histogram("audit.latency_s", "audit hist",
+                          boundaries=(0.1, 1.0), tag_keys=("route",))
+    for v, route in ((0.05, "/a"), (0.5, "/a"), (3.0, "/a"), (0.2, "/b")):
+        h.observe(v, tags={"route": route})
+    c = metrics.Counter("audit.count", "audit counter")
+    c.inc(2.0)
+    metrics.flush_now()
+    time.sleep(0.3)
+    text = metrics.export_prometheus()
+    # headers precede the family's first sample
+    assert "# HELP audit_latency_s audit hist" in text
+    assert "# TYPE audit_latency_s histogram" in text
+    assert text.index("# TYPE audit_latency_s histogram") < \
+        text.index("audit_latency_s_bucket")
+    assert "# TYPE audit_count counter" in text
+    for route, want in (("/a", 3.0), ("/b", 1.0)):
+        buckets = []
+        for m in re.finditer(
+                r'audit_latency_s_bucket\{([^}]*)\} (\S+)', text):
+            labels = dict(re.findall(r'(\w+)="([^"]*)"', m.group(1)))
+            if labels.get("route") == route:
+                buckets.append((labels["le"], float(m.group(2))))
+        assert [b[0] for b in buckets][-1] == "+Inf", buckets
+        vals = [b[1] for b in buckets]
+        assert vals == sorted(vals), f"buckets not cumulative: {buckets}"
+        count = float(re.search(
+            rf'audit_latency_s_count\{{route="{route}"\}} (\S+)',
+            text).group(1))
+        assert vals[-1] == count == want
+        assert re.search(
+            rf'audit_latency_s_sum\{{route="{route}"\}} ', text)
+    # every sample line obeys the text-format grammar
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert re.match(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? \S+$', line), line
+
+
 def test_hung_agent_is_evicted():
     """SIGSTOP the agent (socket stays open, process wedged): only the
     periodic probe can detect and evict it."""

@@ -89,6 +89,57 @@ def test_profile_self_driver(rt):
     assert "burn" in result["folded"]
 
 
+def test_concurrent_profile_requests_no_corruption(tmp_path):
+    """Two concurrent profile requests for the SAME worker id each write
+    through their own tmp file + atomic replace, so the published
+    .stacks.json is always one complete JSON document — and the folded
+    stacks exclude the profiler/signal-handler machinery's own frames
+    (a flamegraph dominated by collect_stacks measures the
+    measurement)."""
+    import os
+    import threading
+
+    from ray_tpu import profiling
+
+    session = str(tmp_path)
+    d = os.path.join(session, "profile")
+    os.makedirs(d)
+    with open(os.path.join(d, "w1.req"), "w") as f:
+        json.dump({"duration_s": 0.6, "hz": 200}, f)
+    stop = []
+
+    def burn_user_code():
+        x = 0
+        while not stop:
+            x += 1
+        return x
+
+    t = threading.Thread(target=burn_user_code, daemon=True)
+    t.start()
+    try:
+        r1 = threading.Thread(target=profiling._run_request,
+                              args=(session, "w1"))
+        r2 = threading.Thread(target=profiling._run_request,
+                              args=(session, "w1"))
+        r1.start()
+        time.sleep(0.05)
+        r2.start()  # overlaps the first request
+        r1.join(15)
+        r2.join(15)
+    finally:
+        stop.append(1)
+    out = os.path.join(d, "w1.stacks.json")
+    with open(out) as f:
+        result = json.load(f)  # a complete, parseable document
+    assert result["samples"] > 0
+    folded = "\n".join(result["stacks"])
+    assert "burn_user_code" in folded
+    for machinery in ("collect_stacks", "_run_request", "_on_signal"):
+        assert machinery not in folded, folded[:2000]
+    # no tmp-file litter left behind
+    assert not [p for p in os.listdir(d) if p.endswith(".tmp")]
+
+
 def test_profile_via_dashboard_endpoint(rt):
     from ray_tpu import state
     from ray_tpu.dashboard import start_dashboard
