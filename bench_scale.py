@@ -13,7 +13,15 @@ here is real (one process per worker) and interpreter-import bound on a
 conflated.
 
 Run: python bench_scale.py [--nodes 100] [--actors 1000]
-     [--tasks 10000] [--pgs 1000]
+     [--tasks 10000] [--pgs 1000] [--skip-actors] [--phases nodes,tasks]
+
+Phase selection: ``--phases`` runs only the named phases (comma list of
+nodes/tasks/pgs/actors) and ``--skip-actors`` drops just the actor wave
+— it is SPAWN-bound (one real interpreter per actor, ~1/s on a small
+host), so control-plane runs shouldn't pay interpreter import time.
+Each phase's JSON also records the head IO loop-lag quantiles observed
+during that phase (head.loop_lag_ms self-probe samples + slow-handler
+deltas), so a throughput number can't silently ride a wedged loop.
 """
 
 import argparse
@@ -61,6 +69,20 @@ def bench_many_tasks(n: int, nodes: int) -> dict:
     # warm the worker pool so the measured phase is dispatch, not fork
     warm = [noop.remote() for _ in range(nodes)]
     ray_tpu.get(warm, timeout=600)
+    # ... and let the warm-up actually finish: worker forks the warm
+    # wave triggered can still be IMPORTING when get() returns (the
+    # driver only needs a few of them to drain the warm tasks), and a
+    # late interpreter import burns ~seconds of CPU inside the measured
+    # window — fork noise, not control-plane throughput
+    from ray_tpu import state
+
+    deadline = time.perf_counter() + 60
+    while time.perf_counter() < deadline:
+        if not any(w["state"] == "starting"
+                   for w in state.list_workers(limit=10000)):
+            break
+        time.sleep(0.25)
+    time.sleep(1.0)
     t0 = time.perf_counter()
     refs = [noop.remote() for _ in range(n)]
     out = ray_tpu.get(refs, timeout=1200)
@@ -131,14 +153,56 @@ def bench_many_pgs(n: int) -> dict:
             "pg_roundtrip_per_s": round(n / (created_dt + removed_dt), 1)}
 
 
+class _LoopLag:
+    """Per-phase head loop-lag capture: snapshot the io_loop state row
+    before a phase, report the lag quantiles + slow-handler delta after
+    it. The lag gauges are the head's own self-probe samples
+    (head.loop_lag_ms), refreshed every housekeeping tick."""
+
+    def snap(self):
+        from ray_tpu import state
+
+        try:
+            row = state.io_loop_stats()[0]
+        except Exception:  # noqa: BLE001 — no cluster yet
+            row = {}
+        self._before = row
+        return self
+
+    def delta(self) -> dict:
+        from ray_tpu import state
+
+        try:
+            row = state.io_loop_stats()[0]
+        except Exception:  # noqa: BLE001
+            return {}
+        before = getattr(self, "_before", {})
+        return {
+            "loop_lag_ms_p50": row.get("loop_lag_ms_p50", 0.0),
+            "loop_lag_ms_p99": row.get("loop_lag_ms_p99", 0.0),
+            "loop_lag_ms_max": row.get("loop_lag_ms_max", 0.0),
+            "slow_events": row.get("slow_events", 0)
+            - before.get("slow_events", 0),
+            "fold_queue_drops": row.get("fold_queue_drops", 0)
+            - before.get("fold_queue_drops", 0),
+        }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=100)
     ap.add_argument("--actors", type=int, default=1000)
     ap.add_argument("--tasks", type=int, default=10_000)
     ap.add_argument("--pgs", type=int, default=1000)
-    ap.add_argument("--out", default="SCALE_r4.json")
+    ap.add_argument("--out", default="SCALE_r11.json")
+    ap.add_argument("--skip-actors", action="store_true",
+                    help="skip the spawn-bound actor wave")
+    ap.add_argument("--phases", default="nodes,tasks,pgs,actors",
+                    help="comma list: which phases to run")
     args = ap.parse_args()
+    phases = {p.strip() for p in args.phases.split(",") if p.strip()}
+    if args.skip_actors:
+        phases.discard("actors")
 
     import ray_tpu
     from ray_tpu.cluster_utils import Cluster
@@ -158,23 +222,54 @@ def main():
 
     cluster = Cluster(initialize_head=True,
                       head_node_args={"num_cpus": 4, "num_tpus": 0})
+    lag = _LoopLag()
     try:
-        print(f"# many_nodes({args.nodes})", file=sys.stderr, flush=True)
-        result["many_nodes"] = bench_many_nodes(cluster, args.nodes)
-        print(json.dumps(result["many_nodes"]), file=sys.stderr)
-        flush()
+        if "nodes" in phases:
+            print(f"# many_nodes({args.nodes})", file=sys.stderr,
+                  flush=True)
+            lag.snap()
+            result["many_nodes"] = bench_many_nodes(cluster, args.nodes)
+            result["many_nodes"]["loop_lag"] = lag.delta()
+            print(json.dumps(result["many_nodes"]), file=sys.stderr)
+            flush()
+        elif {"tasks", "pgs"} & phases:
+            # the task/pg phases expect the virtual node table
+            for _ in range(args.nodes):
+                cluster.add_node(num_cpus=1,
+                                 object_store_memory=64 << 20)
 
-        print(f"# many_tasks({args.tasks})", file=sys.stderr, flush=True)
-        result["many_tasks"] = bench_many_tasks(args.tasks, args.nodes)
-        print(json.dumps(result["many_tasks"]), file=sys.stderr)
-        flush()
+        if "tasks" in phases:
+            print(f"# many_tasks({args.tasks})", file=sys.stderr,
+                  flush=True)
+            lag.snap()
+            result["many_tasks"] = bench_many_tasks(args.tasks,
+                                                    args.nodes)
+            result["many_tasks"]["loop_lag"] = lag.delta()
+            print(json.dumps(result["many_tasks"]), file=sys.stderr)
+            flush()
 
-        print(f"# many_pgs({args.pgs})", file=sys.stderr, flush=True)
-        result["many_pgs"] = bench_many_pgs(args.pgs)
-        print(json.dumps(result["many_pgs"]), file=sys.stderr)
-        flush()
+        if "pgs" in phases:
+            print(f"# many_pgs({args.pgs})", file=sys.stderr, flush=True)
+            lag.snap()
+            result["many_pgs"] = bench_many_pgs(args.pgs)
+            result["many_pgs"]["loop_lag"] = lag.delta()
+            print(json.dumps(result["many_pgs"]), file=sys.stderr)
+            flush()
     finally:
         cluster.shutdown()
+
+    if "actors" not in phases:
+        result["envelope"] = {
+            "nodes_tested": args.nodes if "nodes" in phases else 0,
+            "actors_tested": 0,
+            "tasks_tested": args.tasks if "tasks" in phases else 0,
+            "pgs_tested": args.pgs if "pgs" in phases else 0,
+            "note": "control-plane rates on one host; actor wave "
+                    "skipped (spawn-bound)",
+        }
+        flush()
+        print(json.dumps(result))
+        return
 
     # fresh cluster for the actor wave: 1 CPU per actor across the
     # node table, real worker process per actor
@@ -186,7 +281,9 @@ def main():
             cluster.add_node(num_cpus=12, object_store_memory=64 << 20)
         print(f"# many_actors({args.actors}) over {n_nodes} nodes",
               file=sys.stderr, flush=True)
+        lag.snap()
         result["many_actors"] = bench_many_actors(args.actors)
+        result["many_actors"]["loop_lag"] = lag.delta()
         print(json.dumps(result["many_actors"]), file=sys.stderr)
         flush()
     finally:

@@ -54,7 +54,8 @@ class Config:
     # is PER OBJECT (one _ObjLoc.serving map each): concurrent
     # broadcasts of K different objects held by one node may still put
     # K x fanout streams on that host's uplink — the bound shapes each
-    # object's distribution tree, it is not a host-wide egress limiter.
+    # object's distribution tree; cap what actually leaves the host
+    # with ``host_egress_limit_bps`` (the shared per-host token bucket).
     # 0 disables cooperative planning entirely: every puller stripes
     # across the sealed holder set (the pre-r9 behavior). In-progress
     # locations are
@@ -94,6 +95,20 @@ class Config:
     # TASK_REPLY frame per task, the pre-r8 behavior).
     task_done_batch_max: int = 128
 
+    # Host-wide egress token bucket for the peer-to-peer object plane
+    # (TransferServer): ALL concurrent serves on one host — every
+    # object, every downstream puller, root and relay streams alike —
+    # drain one shared bucket of this many bytes/second. This is the
+    # host-level companion to ``broadcast_fanout``: the fanout bound
+    # shapes each OBJECT's distribution tree, but K concurrent
+    # broadcasts of K different objects held by one node could still
+    # stack K x fanout streams on that host's uplink — the bucket caps
+    # what actually leaves the NIC regardless of how many trees the
+    # planner built through it. 0 (default) disables pacing; benches
+    # and tests also set ``TransferServer.egress_limit_bps`` directly
+    # for uplink emulation.
+    host_egress_limit_bps: int = 0
+
     # --- scheduling ---
     # Hybrid scheduling policy: prefer local node until its utilization
     # exceeds this, then spread (reference: scheduler_spread_threshold).
@@ -111,6 +126,16 @@ class Config:
     # reference's max_pending_lease_requests_per_scheduling_category): the
     # head queues ungrantable requests, so unbounded requests just churn.
     max_pending_lease_requests_per_class: int = 10
+    # Batched lease granting (head dispatcher thread): queued
+    # LEASE_REQUESTs are granted in ONE pass over node state per
+    # dispatch tick — a single head-lock hold instead of a lock/scan
+    # per lease per retry — and a driver granted several leases in one
+    # pass is acked with ONE ``LEASE_GRANT_BATCH`` frame carrying up to
+    # this many grants (the request-side mirror of r8's
+    # TASK_DONE_BATCH). <= 1 disables the batched reply frames (every
+    # grant ships as its own LEASE_REPLY; the single-pass dispatch
+    # itself is always on).
+    lease_grant_batch_max: int = 64
     # Locality-aware leasing (reference: LocalityAwareLeasePolicy +
     # scheduler locality data, locality_aware_lease_policy.h): when a
     # task's by-reference args total at least locality_min_arg_bytes,
@@ -157,6 +182,15 @@ class Config:
     # --- logging / events ---
     log_dir: str = ""
     task_event_buffer_size: int = 10000
+    # Off-loop task-event folding (head fold thread): TASK_EVENTS
+    # batches from the wire queue here and a dedicated thread folds
+    # them into the timeline table — the head IO loop only routes. At
+    # most this many BATCHES may be queued; overflow sheds the batch
+    # (counted in ``fold_queue_drops``, surfaced via io_loop state and
+    # doctor_warnings()) rather than backpressuring the control plane.
+    # Sync flushes (timeline()'s ordering barrier) are acked by the
+    # fold thread only after ingestion, so queries still observe them.
+    task_event_fold_queue_max: int = 512
     # Folded per-task lifecycle timelines on the head (state_ts /
     # phase_ms rows behind `state.list_tasks`): max tasks retained,
     # FIFO-evicted by last activity. Independent of the raw event ring —

@@ -265,16 +265,30 @@ class CoreContext:
             self._handle_task_reply(conn, *msg[2:])
         elif mt == P.TASK_DONE_BATCH:
             # one frame, many completions (the return-side mirror of
-            # PUSH_TASK_BATCH) — unpickled once, dispatched in execution
-            # order
-            for reply in msg[2]:
-                self._handle_task_reply(conn, *reply)
+            # PUSH_TASK_BATCH) — unpickled once, bookkeeping cleared
+            # under ONE lock hold, one submitter wakeup for the frame
+            self._handle_task_reply_batch(conn, msg[2])
 
     def _on_head_message(self, conn: P.Connection, msg):
         mt = msg[0]
         if mt == P.PUSH_TASK:
             # actor creation task pushed by the head scheduler
             self._exec_queue.put((msg[2], conn))
+        elif mt == P.LEASE_GRANT_BATCH:
+            # one batched dispatch pass granted several of our queued
+            # lease requests in ONE frame: complete each blocked
+            # _request_lease call() with its LEASE_REPLY-shaped fields
+            for rid, worker_id, addr, lease_id, tpu_ids in msg[2]:
+                if not self.head.complete_reply(
+                        rid, (True, worker_id, addr, lease_id, None,
+                              tpu_ids)):
+                    # requester thread gave up (shutdown): return the
+                    # lease so the worker doesn't leak
+                    try:
+                        self.head.send(P.RETURN_WORKER, lease_id,
+                                       worker_id)
+                    except P.ConnectionLost:
+                        pass
         elif mt == P.PUBLISH:
             channel, payload = msg[2], msg[3]
             with self._pub_lock:
@@ -793,6 +807,7 @@ class CoreContext:
             r._registered = True
         inflight = _InflightTask(spec, arg_ids, spec.max_retries, holder)
         cls = spec.scheduling_class()
+        wake = True
         with self._sub_lock:
             self._inflight[spec.task_id] = inflight
             for oid in spec.return_ids():
@@ -801,11 +816,18 @@ class CoreContext:
                 # No arg refs → nothing to resolve: queue directly under
                 # the same lock acquisition (the high-rate submission path).
                 st = self._classes.setdefault(cls, _ClassState())
+                # wake the submitter only when the queue was idle: with
+                # work already queued the drain loop re-checks the queue
+                # under this same lock before sleeping, so it cannot
+                # miss this append — and an Event.set() per submit was
+                # a measurable lock ping-pong at flood rates
+                wake = not st.queue
                 st.queue.append(spec)
         if not holder:
             self.events.record(spec.task_id.hex(), spec.name,
                                task_events.PENDING_NODE_ASSIGNMENT)
-            self._submit_event.set()
+            if wake:
+                self._submit_event.set()
             return refs
         self.events.record(spec.task_id.hex(), spec.name,
                            task_events.PENDING_ARGS_AVAIL)
@@ -1179,6 +1201,48 @@ class CoreContext:
                 pass
 
     # -------------------------------------------------- task replies
+
+    def _handle_task_reply_batch(self, conn, replies):
+        """Batched completion handling: the per-reply path cost ~5 lock
+        round-trips per task (inflight clear, RETURNED record, result
+        store, finalize, submitter wakeup) while the submitting thread
+        fought for the same locks — at high completion rates the lock
+        convoy between this IO thread and the submit path was a
+        measured slice of the e2e task budget. One _sub_lock hold
+        clears every reply's dispatch bookkeeping; one _submit_event
+        wakeup covers the whole frame."""
+        now = time.monotonic()
+        normal = []
+        other = []
+        with self._sub_lock:
+            for reply in replies:
+                task_id = TaskID(reply[0])
+                inf = self._inflight.get(task_id)
+                spec = inf.spec if inf else None
+                w = inf.worker if inf is not None else None
+                if w is not None:
+                    w.inflight.pop(task_id, None)
+                    w.idle_since = now
+                    inf.worker = None
+                if spec is None or spec.task_type == TaskType.ACTOR_TASK:
+                    other.append((task_id, reply))
+                else:
+                    normal.append((task_id, spec, reply))
+        for task_id, reply in other:
+            self._handle_actor_reply(task_id, *reply[1:])
+        for task_id, spec, (tb, status, result_meta, err) in normal:
+            self.events.record(task_id.hex(), spec.name,
+                               task_events.RETURNED)
+            if status == "ok":
+                self._store_results(spec, result_meta)
+                self._finalize_task(spec)
+            elif status == "cancelled":
+                self._finish_cancelled(spec)
+            elif spec.retry_exceptions:
+                self._maybe_retry(spec, err, count_retry=True)
+            else:
+                self._complete_task_error(spec, err)
+        self._submit_event.set()
 
     def _handle_task_reply(self, conn, task_id_bin, status, result_meta, err):
         task_id = TaskID(task_id_bin)

@@ -26,12 +26,12 @@ from __future__ import annotations
 
 import threading
 import time
-import uuid
 from collections import deque
 from typing import Optional, Tuple
 
 from . import protocol as P
 from .config import get_config
+from .ids import _random_bytes
 
 # task states (reference: src/ray/protobuf/common.proto TaskStatus —
 # PENDING_ARGS_AVAIL -> PENDING_NODE_ASSIGNMENT -> SUBMITTED_TO_WORKER ->
@@ -87,6 +87,16 @@ PHASE_BOUNDS = (
 )
 TASK_PHASES = tuple(name for name, _, _ in PHASE_BOUNDS)
 
+# state -> the PHASE_BOUNDS entries that have this state as a start or
+# an end. The head's fold only re-derives phases a newly-stamped state
+# could have completed — deriving ALL six per folded event was a
+# measurable slice of the fold thread's hot loop.
+PHASES_TOUCHING = {}
+for _pb in PHASE_BOUNDS:
+    for _st in _pb[1] + _pb[2]:
+        PHASES_TOUCHING.setdefault(_st, []).append(_pb)
+del _pb, _st
+
 
 def _first_stamp(stamps: dict, states) -> Optional[float]:
     for s in states:
@@ -128,7 +138,9 @@ _trace_tls = threading.local()
 
 
 def new_span_id() -> str:
-    return uuid.uuid4().hex[:16]
+    # pooled entropy, not uuid4: uuid4 hits os.urandom per call (~34 us
+    # on the deployment kernel) and a span id is minted PER TASK
+    return _random_bytes(8).hex()
 
 
 def current_trace() -> Optional[Tuple[str, str]]:
@@ -152,7 +164,7 @@ def submit_trace_ctx() -> Tuple[str, str]:
     ctx = current_trace()
     if ctx is not None:
         return ctx
-    return (uuid.uuid4().hex, "")
+    return (_random_bytes(16).hex(), "")
 
 
 class TaskEventBuffer:

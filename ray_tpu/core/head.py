@@ -26,15 +26,17 @@ import subprocess
 import sys
 import threading
 import time
-import uuid
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
+from . import events as E
 from . import protocol as P
 from .protocol import local_ip as _local_ip
 from .config import get_config
-from .ids import ActorID, ObjectID, PlacementGroupID
+from .ids import ActorID, ObjectID, PlacementGroupID, _random_bytes
+from .obj_directory import ObjectDirectory, _ObjLoc  # noqa: F401 — _ObjLoc
+#   re-exported: planner tests and older callers import it from here
 from .object_store import ShmObjectStore
 from .persistence import HeadStore
 from .resources import NodeResources, ResourceSet, detect_node_resources
@@ -117,37 +119,6 @@ class NodeState:
 
 
 @dataclass
-class _ObjLoc:
-    """Object directory entry (reference: ObjectDirectory,
-    src/ray/object_manager/object_directory.h — the full HOLDER SET per
-    object, not just the sealing node). ``node_idx`` stays the primary
-    location for the single-location paths (locate replies, spill);
-    ``holders`` is every node with a sealed copy and always contains
-    ``node_idx`` while it is >= 0."""
-
-    node_idx: int = -1
-    size: int = 0
-    owner: str = ""
-    spilled_path: str = ""
-    holders: Set[int] = field(default_factory=set)
-    waiters: List[Tuple[P.Connection, int]] = field(default_factory=list)
-    # Cooperative broadcast (in-progress locations): nodes the head has
-    # told to pull this object whose pull has not completed yet, mapped
-    # to their transfer address — the planner may point LATER pullers at
-    # them (chunk relay). Entries leave the moment the pull finishes
-    # (promoted to ``holders``) or aborts (never handed out again).
-    inprog: Dict[int, str] = field(default_factory=dict)
-    # Stripe-weighted active downstream pulls per source transfer
-    # address (sealed holders and relays alike): a pull striped across
-    # k roots charges each 1/k — it only takes ~1/k of each uplink —
-    # while a relay-served pull charges its one source a full 1.0. The
-    # planner skips sources at the ``broadcast_fanout`` bound, which is
-    # what bends N simultaneous pullers into a pipelined tree instead
-    # of N streams off one uplink.
-    serving: Dict[str, float] = field(default_factory=dict)
-
-
-@dataclass
 class _TaskTimeline:
     """Folded per-task lifecycle row (reference: GcsTaskManager's
     per-task state aggregation over task_event_buffer flushes). Events
@@ -211,9 +182,21 @@ class Head:
         self.pgs: Dict[PlacementGroupID, PgInfo] = {}
         self.kv: Dict[str, Dict[str, bytes]] = {}
         self.subs: Dict[str, Set[P.Connection]] = {}
-        self.objects: Dict[ObjectID, _ObjLoc] = {}
+        # Sharded object directory (obj_directory.py): holder sets,
+        # blocked-locate waiters, broadcast in-progress locations — all
+        # under per-shard locks, OFF the head lock, so directory traffic
+        # never convoys behind lease granting or the event fold.
+        self.objects = ObjectDirectory()
         self.leases: Dict[str, Tuple[int, ResourceSet, str, Optional[tuple]]] = {}
         self._lock = threading.RLock()
+        # Control-plane lock split (r11): the head lock now guards ONLY
+        # the node/worker/lease/actor/PG/kv tables. Observability state
+        # has its own locks so a dashboard poll or a metrics merge can
+        # never stall a lease grant (ordering, outermost first:
+        # _lock -> _timeline_lock -> _metrics_lock; _cev_lock is a leaf).
+        self._timeline_lock = threading.RLock()
+        self._metrics_lock = threading.RLock()
+        self._cev_lock = threading.Lock()
         self._pending_pg: List[PlacementGroupID] = []
         # lease requests waiting for a worker/resources:
         # (conn, request_id, sched_class, request, strategy_bytes, job)
@@ -252,18 +235,37 @@ class Head:
         # heads that are never start()ed (unit tests drive handlers
         # directly).
         self._spawn_q: "queue.Queue" = queue.Queue()
-        # Objects that were sealed and then lost with their node (no other
-        # copy, not spilled). A locate on these answers -2 immediately so
-        # owners can run lineage reconstruction instead of blocking forever
-        # (reference: ObjectRecoveryManager, object_recovery_manager.h:41).
-        # Insertion-ordered dict, FIFO-capped: ids whose owner died with
-        # the node are never recovered/freed and would otherwise leak.
-        self.lost_objects: Dict[ObjectID, None] = {}
+        # Batched lease dispatch (r11): LEASE_REQUESTs queue here and a
+        # dedicated dispatcher thread grants them in ONE pass over node
+        # state per tick (one lock hold, strategies pre-parsed), replying
+        # per-connection in LEASE_GRANT_BATCH frames. Handlers that free
+        # resources just signal the event — the O(pending^2) re-grant
+        # loop the IO thread used to run per message (measured 60-190 ms
+        # per REGISTER/RETURN_WORKER at burst) is gone.
+        self._dispatch_event = threading.Event()
+        self._dispatcher: Optional[threading.Thread] = None
+        self.lease_grant_batches = 0   # LEASE_GRANT_BATCH frames sent
+        self.lease_grants_batched = 0  # grants that rode those frames
+        self._lease_seq = itertools.count(1)
+        self._lease_prefix = _random_bytes(8).hex()
         # Task-event ring buffer feeding the state API (reference:
         # GcsTaskManager over task_event_buffer.h flushes).
         self.task_events: "deque" = deque(
             maxlen=get_config().task_event_buffer_size)
         self.task_events_dropped = 0
+        # Off-loop event folding (r11): TASK_EVENTS batches from the wire
+        # land in this bounded queue and a dedicated fold thread does the
+        # timeline/histogram work — the commutative fold makes the move
+        # safe, and the IO loop goes back to being a router. Flush-acks
+        # (rid > 0) are issued by the fold thread AFTER ingesting the
+        # batch, preserving the ordering barrier timeline() relies on.
+        # Overflow sheds the batch (observability must never backpressure
+        # the control plane) and counts it: fold_queue_drops is surfaced
+        # through io_loop state + doctor_warnings().
+        self._fold_q: "deque" = deque()
+        self._fold_event = threading.Event()
+        self._fold_thread: Optional[threading.Thread] = None
+        self.fold_queue_drops = 0
         # Folded per-task lifecycle timelines (bounded, FIFO-evicted;
         # reference: GcsTaskManager task aggregation): state_ts /
         # phase_ms for list_tasks, the task.phase_ms{func,phase} +
@@ -374,6 +376,16 @@ class Head:
         self._spawner = threading.Thread(
             target=self._spawn_loop, daemon=True, name="head-spawner")
         self._spawner.start()
+        # Task-event fold thread: folds TASK_EVENTS batches into the
+        # timeline table off the IO loop (handlers just enqueue). Started
+        # here so unstarted unit-test heads keep folding inline.
+        self._fold_thread = threading.Thread(
+            target=self._fold_loop, daemon=True, name="head-fold")
+        self._fold_thread.start()
+        # Lease dispatcher thread: batched grant passes off the IO loop.
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="head-dispatch")
+        self._dispatcher.start()
         # Prestart the worker pool (reference: WorkerPool prestart,
         # worker_pool.cc num_prestarted_python_workers): interpreter
         # startup costs O(seconds); forking CPU-count workers now means a
@@ -413,7 +425,7 @@ class Head:
     def _read_local_object(self, oid: ObjectID):
         """TransferServer read_fn over every in-process node store: any
         local holder in the directory can serve the pull (primary first)."""
-        with self._lock:
+        with self.objects.lock_for(oid):
             loc = self.objects.get(oid)
             if loc is None:
                 return None
@@ -572,6 +584,7 @@ class Head:
         with self._lock:
             node = self.nodes.pop(idx, None)
             self.scheduler.remove_node(idx)
+        with self._metrics_lock:
             self.node_telemetry.pop(idx, None)
             # prune the node's telemetry gauges from the merged metric
             # table too — a dead host must not keep exporting
@@ -609,29 +622,12 @@ class Head:
         # creating task (lineage reconstruction; reference:
         # object_recovery_manager.h:41). Objects with surviving replicas
         # in the directory just fail over to another holder.
-        lost_waiters: List[Tuple[P.Connection, int]] = []
         # broadcast bookkeeping for the dead host: it can no longer be a
         # relay (in-progress location) nor serve its assigned downstream
         # pulls — drop both so the planner stops routing at it (its
         # in-flight downstream pullers fail over via connection loss)
         dead_addr = node.transfer_addr if node.is_remote else ""
-        with self._lock:
-            lost = []
-            for oid, loc in list(self.objects.items()):
-                loc.holders.discard(idx)
-                loc.inprog.pop(idx, None)
-                if dead_addr:
-                    loc.serving.pop(dead_addr, None)
-                if loc.node_idx != idx:
-                    continue
-                if loc.holders:
-                    loc.node_idx = min(loc.holders)  # promote a replica
-                elif loc.spilled_path:
-                    loc.node_idx = -1
-                else:
-                    lost.append(oid)
-            lost_waiters = self._mark_objects_lost(lost)
-        self._reply_lost(lost_waiters)
+        self._reply_lost(self.objects.purge_node(idx, dead_addr))
         if node.store is not None:
             node.store.close()
         if node.agent_conn is not None:
@@ -757,60 +753,127 @@ class Head:
 
     def _queue_lease(self, conn, rid, sched_class, resources, job_id_hex,
                      strategy_bytes, arg_ids=None):
+        # the strategy is parsed ONCE at enqueue — the old per-pass
+        # loads() re-parsed every queued request on every dispatch retry
+        strategy = loads(strategy_bytes)
         with self._lock:
             self._pending_leases.append(
                 (conn, rid, tuple(sched_class), ResourceSet(resources),
-                 job_id_hex, strategy_bytes, arg_ids))
+                 job_id_hex, strategy_bytes, arg_ids, strategy))
 
     def _try_fulfill_pending(self):
-        """Dispatch loop: try to grant queued leases (reference:
-        ClusterTaskManager::ScheduleAndDispatchTasks)."""
-        from .task_spec import SchedulingStrategy
+        """Kick the lease dispatcher (reference:
+        ClusterTaskManager::ScheduleAndDispatchTasks). With the
+        dispatcher thread running (start()ed heads) this only signals
+        it — callers on the IO loop return immediately; unstarted
+        unit-test heads run the batched pass inline."""
+        d = self._dispatcher
+        if d is not None and d.is_alive():
+            self._dispatch_event.set()
+        else:
+            self._dispatch_pass()
 
-        while True:
-            granted = False
-            with self._lock:
-                pending = list(self._pending_leases)
+    def _dispatch_loop(self):
+        while not self._shutdown:
+            self._dispatch_event.wait(0.25)
+            self._dispatch_event.clear()
+            if self._shutdown:
+                return
+            try:
+                self._dispatch_pass()
+            except Exception:
+                if not self._shutdown:
+                    import traceback
+
+                    traceback.print_exc()
+
+    def _dispatch_pass(self):
+        """ONE batched grant pass: every pending lease is tried under a
+        single head-lock hold, and the grants are replied per-connection
+        afterwards — many grants to one driver ride a single
+        LEASE_GRANT_BATCH frame (the request-side mirror of r8's
+        TASK_DONE_BATCH). Requests that stay ungrantable remain queued;
+        anything that frees resources re-signals the dispatcher."""
+        by_conn: Dict[P.Connection, list] = {}
+        with self._lock:
+            if not self._pending_leases:
+                return
+            pending = list(self._pending_leases)
             demand: dict = {}
             for item in pending:
                 demand[item[2]] = demand.get(item[2], 0) + 1
+            # ONE cluster-wide starting-workers scan per pass (the
+            # spawn gate reads it per attempt; rescanning nodes x
+            # workers per pending lease would put O(pending * workers)
+            # back under the head-lock hold)
+            spawn_budget = [self._count_starting(time.monotonic())]
             for item in pending:
-                (conn, rid, sched_class, request, job_hex, strategy_bytes,
-                 arg_ids) = item
-                strategy: SchedulingStrategy = loads(strategy_bytes)
-                grant = self._try_grant(sched_class, request, strategy,
-                                        demand=demand.get(sched_class, 1),
-                                        arg_ids=arg_ids)
+                (conn, rid, sched_class, request, _job_hex, _sb,
+                 arg_ids, strategy) = item
+                grant = self._try_grant_locked(
+                    sched_class, request, strategy,
+                    demand=demand.get(sched_class, 1), arg_ids=arg_ids,
+                    spawn_budget=spawn_budget)
                 if grant is None:
                     continue
-                with self._lock:
-                    try:
-                        self._pending_leases.remove(item)
-                    except ValueError:
-                        continue
-                granted = True
-                worker, lease_id = grant
-                if worker == "spawning":
-                    continue  # re-queued internally once worker registers
-                tpu_ids = self.leases[lease_id][4]
                 try:
-                    conn.reply(rid, True, worker.worker_id,
-                               worker.listen_addr, lease_id, None, tpu_ids,
-                               msg_type=P.LEASE_REPLY)
-                except P.ConnectionLost:
-                    # Requester (driver) died while its lease request was
-                    # queued — undo the grant so the worker and resources
-                    # return to the pool instead of leaking.
-                    self._h_return_worker(conn, 0, lease_id,
-                                          worker.worker_id)
-            if not granted:
-                return
+                    self._pending_leases.remove(item)
+                except ValueError:
+                    continue
+                worker, lease_id = grant
+                tpu_ids = self.leases[lease_id][4]
+                by_conn.setdefault(conn, []).append(
+                    (rid, worker.worker_id, worker.listen_addr, lease_id,
+                     tpu_ids))
+        if not by_conn:
+            return
+        batch_max = get_config().lease_grant_batch_max
+        for conn, grants in by_conn.items():
+            try:
+                if batch_max > 1 and len(grants) > 1:
+                    for i in range(0, len(grants), batch_max):
+                        chunk = grants[i:i + batch_max]
+                        conn.send(P.LEASE_GRANT_BATCH, chunk)
+                        self.lease_grant_batches += 1
+                        self.lease_grants_batched += len(chunk)
+                else:
+                    for rid, wid, addr, lease_id, tpu_ids in grants:
+                        conn.reply(rid, True, wid, addr, lease_id, None,
+                                   tpu_ids, msg_type=P.LEASE_REPLY)
+            except P.ConnectionLost:
+                # Requester (driver) died while its lease request was
+                # queued — undo the grants so the workers and resources
+                # return to the pool instead of leaking.
+                for _rid, wid, _addr, lease_id, _tpu in grants:
+                    self._h_return_worker(conn, 0, lease_id, wid)
 
     def _try_grant(self, sched_class, request: ResourceSet, strategy,
                    demand: int = 1, arg_ids=None
                    ) -> Optional[Tuple[object, str]]:
-        """Try to allocate resources + a worker. Returns (WorkerInfo, lease)
-        or ("spawning", "") if a worker is being started, or None.
+        with self._lock:
+            return self._try_grant_locked(sched_class, request, strategy,
+                                          demand=demand, arg_ids=arg_ids)
+
+    def _count_starting(self, now: float) -> int:
+        """Cluster-wide count of workers still forking/importing
+        (caller holds the lock)."""
+        return sum(1 for n in self.nodes.values()
+                   for w in n.workers.values()
+                   if w.state == "starting" and now - w.spawned_at < 60.0)
+
+    def _try_grant_locked(self, sched_class, request: ResourceSet, strategy,
+                          demand: int = 1, arg_ids=None, spawn_budget=None
+                          ) -> Optional[Tuple[object, str]]:
+        """Try to allocate resources + a worker. Returns (WorkerInfo,
+        lease_id) on success, or None (possibly after kicking off a
+        worker spawn — the request stays queued and re-tries once the
+        worker registers).
+
+        ``spawn_budget`` — one-element list holding the cluster-wide
+        count of starting workers, shared across one dispatch pass so
+        the gate below reads (and bumps) it instead of rescanning every
+        node's worker table per pending lease; None (direct callers,
+        e.g. actor scheduling) computes it fresh.
 
         ``demand`` caps the spawn stampede: if at least that many workers of
         any class are already starting on the node, no new process is forked
@@ -824,7 +887,10 @@ class Head:
         sizes total at least ``locality_min_arg_bytes``, the node already
         holding the most argument bytes is preferred over the hybrid
         policy — the bytes then never move at all (reference:
-        LocalityAwareLeasePolicy over the object directory)."""
+        LocalityAwareLeasePolicy over the object directory).
+
+        Callers hold the head lock (the RLock re-entry below costs a
+        counter bump and keeps direct callers safe)."""
         cfg = get_config()
         with self._lock:
             loc_choice = None
@@ -843,7 +909,7 @@ class Head:
                 # the object_plane endpoint reports by the retry rate
                 if (arg_ids and cfg.scheduler_locality_enabled
                         and strategy.kind == "DEFAULT"):
-                    scores, total = self._locality_scores(arg_ids)
+                    scores, total = self.objects.locality_scores(arg_ids)
                     if total >= cfg.locality_min_arg_bytes:
                         node_idx = self.scheduler.best_locality_node(
                             request, scores)
@@ -854,6 +920,19 @@ class Head:
                 if node_idx is None:
                     return None
             node = self.nodes[node_idx]
+            if (pg_id is None and loc_choice is None
+                    and strategy.kind == "DEFAULT"
+                    and not any(node.idle_by_class.values())):
+                # The policy's pick would have to FORK an interpreter
+                # (20-300 ms of syscalls plus seconds of imports) while
+                # another feasible node already holds a warm idle worker
+                # — retarget there (reference analog: the WorkerPool's
+                # idle-worker reuse preference). The scale bench measured
+                # 16 mid-wave forks with 20 idle workers sitting on
+                # unchosen nodes before this.
+                alt = self._node_with_idle_worker(sched_class, request)
+                if alt is not None:
+                    node_idx, node = alt
             # Affinity may target a feasible-but-busy node: stay queued.
             if pg_id is None and not node.resources.is_available(request):
                 return None
@@ -862,7 +941,10 @@ class Head:
                 self._pg_allocate(pg_id, strategy.bundle_index, request)
             else:
                 node.resources.allocate(request)
-            lease_id = uuid.uuid4().hex
+            # pooled-entropy lease ids: uuid4 hits os.urandom per call
+            # (~34 us on the deployment kernel) and a burst pass mints
+            # one per grant ATTEMPT, rolled back or not
+            lease_id = f"{self._lease_prefix}{next(self._lease_seq):x}"
             tpu_ids = self._allocate_tpu_chips(node, request)
             pg_binding = pg_id and (pg_id, strategy.bundle_index)
             self.leases[lease_id] = (node_idx, request, "", pg_binding,
@@ -912,10 +994,21 @@ class Head:
             # NOT gated on total live workers: leased workers may belong
             # to long-lived actors of other classes (counting them
             # starved gang creation on busy nodes); bounding STARTING
-            # forks per node at its request-concurrency is what stops
-            # the storm
-            if starting < min(demand, node_cap):
-                self._spawn_worker(node, sched_class)
+            # forks per node at its request-concurrency stops the
+            # per-node storm. ALSO gated CLUSTER-WIDE at ``demand``:
+            # the hybrid policy's randomized pick lands each retry pass
+            # on fresh nodes, and the per-node gate alone let a 100-node
+            # table fork up to 10 interpreters per pass on
+            # never-before-touched nodes until ~100 were importing at
+            # once on 2 cores (measured: head loop-lag p99 2.4s during
+            # the scale wave from fork+import CPU alone). We never need
+            # more forks in flight than ungranted requests exist.
+            if spawn_budget is None:
+                spawn_budget = [self._count_starting(now)]
+            if starting < min(demand, node_cap) and \
+                    spawn_budget[0] < demand:
+                if self._spawn_worker(node, sched_class) is not None:
+                    spawn_budget[0] += 1
             # roll back allocation; the pending lease will re-acquire
             if pg_id is not None:
                 self._pg_release(pg_id, strategy.bundle_index, request)
@@ -924,6 +1017,23 @@ class Head:
             self._release_tpu_chips(node, tpu_ids)
             del self.leases[lease_id]
             return None
+
+    def _node_with_idle_worker(self, sched_class, request: ResourceSet
+                               ) -> Optional[Tuple[int, NodeState]]:
+        """A schedulable node that can take ``request`` right now AND
+        already holds an idle worker — exact scheduling class preferred,
+        any-class repurpose otherwise. Caller holds the lock."""
+        fallback = None
+        for idx in self.scheduler.schedulable_nodes():
+            n = self.nodes.get(idx)
+            if n is None or not n.alive or \
+                    not n.resources.is_available(request):
+                continue
+            if n.idle_by_class.get(sched_class):
+                return idx, n
+            if fallback is None and any(n.idle_by_class.values()):
+                fallback = (idx, n)
+        return fallback
 
     def _count_locality(self, loc_choice: Optional[str]):
         """Locality placement counters, bumped only on a completed grant
@@ -964,7 +1074,7 @@ class Head:
         if len([w for w in node.workers.values() if w.state != "dead"]) >= \
                 cfg.max_workers_per_node:
             return None  # type: ignore[return-value]
-        worker_id = uuid.uuid4().hex
+        worker_id = _random_bytes(16).hex()
         w = WorkerInfo(worker_id=worker_id, node_idx=node.idx,
                        sched_class=sched_class,
                        spawned_at=time.monotonic())
@@ -1561,15 +1671,8 @@ class Head:
 
     def _h_object_sealed(self, conn, rid, oid_bin, node_idx, size, owner):
         oid = ObjectID(oid_bin)
-        with self._lock:
-            self.lost_objects.pop(oid, None)  # a recovered object is found again
-            loc = self.objects.setdefault(oid, _ObjLoc())
-            loc.node_idx = node_idx
-            loc.size = size
-            loc.owner = owner
-            loc.holders.add(node_idx)
-            waiters = list(loc.waiters)
-            loc.waiters.clear()
+        node_idx, size, waiters = self.objects.record_sealed(
+            oid, node_idx, size, owner)
         for wconn, wrid in waiters:
             try:
                 wconn.reply(wrid, node_idx, size, "",
@@ -1580,19 +1683,8 @@ class Head:
 
     def _directory_add(self, oid: ObjectID, node_idx: int, size: int = 0):
         """A node gained a copy (pull completion / replica creation)."""
-        waiters: List[Tuple[P.Connection, int]] = []
-        with self._lock:
-            self.lost_objects.pop(oid, None)
-            loc = self.objects.setdefault(oid, _ObjLoc())
-            loc.holders.add(node_idx)
-            if size > 0 and loc.size <= 0:
-                loc.size = size
-            if loc.node_idx < 0:
-                loc.node_idx = node_idx
-            if loc.waiters:
-                waiters = list(loc.waiters)
-                loc.waiters.clear()
-            node_idx, size = loc.node_idx, loc.size
+        node_idx, size, waiters = self.objects.add_location(
+            oid, node_idx, size)
         for wconn, wrid in waiters:
             try:
                 wconn.reply(wrid, node_idx, size, "",
@@ -1612,8 +1704,7 @@ class Head:
         locked head path, including the head puller's IO thread — but the
         LOST-waiter replies are blocking socket writes, so they go to a
         side thread rather than stalling whatever triggered the eviction."""
-        waiters = self._directory_remove(
-            [oid.binary() for oid in oids], node_idx)
+        waiters = self.objects.remove_locations(list(oids), node_idx)
         if waiters:
             threading.Thread(target=self._reply_lost, args=(waiters,),
                              daemon=True).start()
@@ -1621,57 +1712,10 @@ class Head:
     def _h_obj_location_remove(self, conn, rid, oid_bins, node_idx):
         """A node dropped copies (arena eviction / local deletion) — one
         batched message per eviction sweep."""
-        self._reply_lost(self._directory_remove(oid_bins, node_idx))
+        self._reply_lost(self.objects.remove_locations(
+            [ObjectID(ob) for ob in oid_bins], node_idx))
         if rid > 0:
             conn.reply(rid, True)
-
-    def _directory_remove(self, oid_bins, node_idx: int
-                          ) -> List[Tuple[P.Connection, int]]:
-        """Holder-set removal bookkeeping; returns the blocked-locate
-        waiters that must hear the LOST sentinel (reply via _reply_lost
-        off the caller's critical path)."""
-        with self._lock:
-            lost = []
-            for ob in oid_bins:
-                oid = ObjectID(ob)
-                loc = self.objects.get(oid)
-                # Only act when the node is a recorded holder: an eviction
-                # report racing ahead of the sealing worker's OBJECT_SEALED
-                # (different head connections — cross-connection order is
-                # not guaranteed) must not declare a never-sealed waiter
-                # entry LOST. The inverse race (remove lands before the
-                # entry even exists, leaving a stale holder once SEALED
-                # arrives) is benign: pulls fail over off stale entries
-                # per-object.
-                if loc is None or node_idx not in loc.holders:
-                    continue
-                loc.holders.discard(node_idx)
-                if loc.node_idx == node_idx:
-                    loc.node_idx = min(loc.holders) if loc.holders else -1
-                if loc.node_idx < 0 and not loc.spilled_path:
-                    # last copy evicted and nothing on disk: the object is
-                    # LOST — same outcome as its node dying
-                    lost.append(oid)
-            return self._mark_objects_lost(lost)
-
-    def _mark_objects_lost(self, oids
-                           ) -> List[Tuple[P.Connection, int]]:
-        """Drop directory entries whose final copy is gone and remember
-        the ids as LOST (bounded set) so later locates fail fast — owners
-        react by re-executing the creating task (lineage reconstruction;
-        reference: object_recovery_manager.h:41). Caller holds the lock;
-        pass the returned blocked-locate waiters to ``_reply_lost`` AFTER
-        releasing it."""
-        waiters: List[Tuple[P.Connection, int]] = []
-        for oid in oids:
-            loc = self.objects.pop(oid, None)
-            if loc is not None:
-                waiters.extend(loc.waiters)
-                loc.waiters.clear()
-            self.lost_objects[oid] = None
-        while len(self.lost_objects) > 65536:
-            self.lost_objects.pop(next(iter(self.lost_objects)))
-        return waiters
 
     def _reply_lost(self, waiters):
         """Answer blocked locates with the LOST sentinel (-2)."""
@@ -1687,8 +1731,9 @@ class Head:
         ('' when that holder has no reachable transfer server), so two
         head-local holders both report the head's one TransferServer
         address. A puller dedupes before striping."""
-        with self._lock:
-            loc = self.objects.get(ObjectID(oid_bin))
+        oid = ObjectID(oid_bin)
+        with self.objects.lock_for(oid):
+            loc = self.objects.get(oid)
             if loc is None:
                 conn.reply(rid, [], [], 0, "")
                 return
@@ -1698,29 +1743,15 @@ class Head:
             size, spilled = loc.size, loc.spilled_path
         conn.reply(rid, holders, addrs, size, spilled)
 
-    def _locality_scores(self, arg_ids) -> Tuple[Dict[int, int], int]:
-        """Per-node bytes of the given args already resident there, plus
-        the args' total size. Caller holds the lock."""
-        scores: Dict[int, int] = {}
-        total = 0
-        for ob in dict.fromkeys(arg_ids):  # a dup arg counts its bytes once
-            loc = self.objects.get(ObjectID(ob))
-            if loc is None or loc.size <= 0:
-                continue
-            total += loc.size
-            for h in loc.holders:
-                scores[h] = scores.get(h, 0) + loc.size
-        return scores, total
-
     def _h_object_locate(self, conn, rid, oid_bin, block):
         oid = ObjectID(oid_bin)
-        with self._lock:
+        with self.objects.lock_for(oid):
             loc = self.objects.get(oid)
             if loc is not None and (loc.node_idx >= 0 or loc.spilled_path):
                 conn.reply(rid, loc.node_idx, loc.size, loc.spilled_path,
                            msg_type=P.OBJECT_LOCATE_REPLY)
                 return
-            if oid in self.lost_objects:
+            if self.objects.is_lost(oid):
                 # sealed once, then its node died: fail fast so the owner
                 # can reconstruct instead of blocking forever
                 conn.reply(rid, -2, 0, "", msg_type=P.OBJECT_LOCATE_REPLY)
@@ -1728,42 +1759,38 @@ class Head:
             if not block:
                 conn.reply(rid, -1, 0, "", msg_type=P.OBJECT_LOCATE_REPLY)
                 return
-            loc = self.objects.setdefault(oid, _ObjLoc())
-            loc.waiters.append((conn, rid))
+            self.objects.setdefault(oid).waiters.append((conn, rid))
 
     def _h_seal_aborted(self, conn, rid, oid_bins):
         """The creating task failed permanently: these returns will never
         seal. Mark them LOST and answer blocked locates with -2 so
         borrowers surface ObjectLostError instead of hanging (the owner
         holds the actual error in its in-process store)."""
-        with self._lock:
-            lost = []
-            for ob in oid_bins:
-                oid = ObjectID(ob)
+        lost = []
+        for ob in oid_bins:
+            oid = ObjectID(ob)
+            with self.objects.lock_for(oid):
                 loc = self.objects.get(oid)
                 if loc is not None and (loc.node_idx >= 0 or
                                         loc.spilled_path):
                     continue  # a real copy exists (e.g. partial returns)
                 lost.append(oid)
-            waiters = self._mark_objects_lost(lost)
-        self._reply_lost(waiters)
+        self._reply_lost(self.objects.mark_lost(lost))
 
     def _h_object_recovering(self, conn, rid, oid_bins):
         """An owner is re-executing the creating task for these lost
         objects: clear the LOST marker so consumers' blocking locates queue
         as waiters for the re-seal rather than failing fast."""
-        with self._lock:
-            for ob in oid_bins:
-                self.lost_objects.pop(ObjectID(ob), None)
+        for ob in oid_bins:
+            self.objects.clear_lost(ObjectID(ob))
         if rid > 0:
             conn.reply(rid, True)
 
     def _h_object_free(self, conn, rid, oid_bins):
         for ob in oid_bins:
             oid = ObjectID(ob)
-            with self._lock:
-                loc = self.objects.pop(oid, None)
-                self.lost_objects.pop(oid, None)
+            loc = self.objects.pop(oid)
+            self.objects.clear_lost(oid)
             if loc is None:
                 continue
             if loc.spilled_path:
@@ -1871,7 +1898,7 @@ class Head:
         saturated, overload the least-loaded root and note it."""
         cfg = get_config()
         fanout = cfg.broadcast_fanout
-        with self._lock:
+        with self.objects.lock_for(oid):
             sealed_addrs = list(dict.fromkeys(
                 a for n in self._holder_nodes(loc, exclude_idx=dst_node.idx)
                 for a in (self._node_transfer_addr(n),) if a))
@@ -1934,12 +1961,12 @@ class Head:
                                 charged):
         """A brokered pull ended (either way): release the source slots
         it charged and retire the requester's in-progress location.
-        Shares the head lock with the planner, so an aborted/failed
-        puller can never be handed out as a source after its failure is
-        known (directory-staleness-on-abort guarantee)."""
+        Shares the object's SHARD lock with the planner, so an
+        aborted/failed puller can never be handed out as a source after
+        its failure is known (directory-staleness-on-abort guarantee)."""
         if not charged:
             return  # non-cooperative plan: nothing was registered
-        with self._lock:
+        with self.objects.lock_for(oid):
             loc = self.objects.get(oid)
             if loc is None:
                 return
@@ -2018,7 +2045,7 @@ class Head:
         delivered by this same head IO thread — so any transfer touching a
         remote node runs on a side thread (otherwise: deadlock)."""
         oid = ObjectID(oid_bin)
-        with self._lock:
+        with self.objects.lock_for(oid):
             loc = self.objects.get(oid)
             any_remote_holder = loc is not None and any(
                 self.nodes[h].is_remote for h in loc.holders
@@ -2039,7 +2066,7 @@ class Head:
             if self._node_store_contains(dst_node, oid):
                 conn.reply(rid, True)
                 return
-            with self._lock:
+            with self.objects.lock_for(oid):
                 any_remote_holder = any(
                     self.nodes[h].is_remote for h in loc.holders
                     if h in self.nodes)
@@ -2062,7 +2089,7 @@ class Head:
                 payload = data[8 + meta_len:]
             else:
                 # relay read from any live holder (primary first)
-                with self._lock:
+                with self.objects.lock_for(oid):
                     cand = self._holder_nodes(loc)
                 got = None
                 for node in cand:
@@ -2103,11 +2130,10 @@ class Head:
             return
         spill_dir = cfg.spill_dir or os.path.join(self.session_dir, "spill")
         os.makedirs(spill_dir, exist_ok=True)
-        with self._lock:
-            candidates = [
-                (oid, loc) for oid, loc in self.objects.items()
-                if loc.node_idx == node_idx and not loc.spilled_path
-            ]
+        candidates = [
+            (oid, loc) for oid, loc in self.objects.items_snapshot()
+            if loc.node_idx == node_idx and not loc.spilled_path
+        ]
         target = store.capacity() * (cfg.object_spilling_threshold - 0.2)
         spilled_n, spilled_bytes = 0, 0
         for oid, loc in candidates:
@@ -2126,7 +2152,7 @@ class Head:
             finally:
                 del data_v, meta_v, got
                 store.release(oid)
-            with self._lock:
+            with self.objects.lock_for(oid):
                 loc.spilled_path = path
                 loc.holders.discard(node_idx)
                 # another node may still hold a live replica; only fall
@@ -2149,8 +2175,9 @@ class Head:
         """Merge per-process metric deltas into the cluster aggregate
         (reference: opencensus exporter -> dashboard agent; stats/
         metric.h:103). Counters/histograms arrive as deltas and sum;
-        gauges overwrite."""
-        with self._lock:
+        gauges overwrite. Runs under the dedicated metrics lock — merge
+        work never convoys a lease grant on the head lock."""
+        with self._metrics_lock:
             for kind, name, desc, meta, tags_key, value in batch:
                 # reporter telemetry rows are identified by name prefix
                 # AND the reserved ("node",) tag-key shape, so user
@@ -2208,8 +2235,56 @@ class Head:
         issued only after ingestion, so a subsequent STATE_QUERY
         observes this batch (tracing.timeline's ordering barrier).
         Every event is ALSO folded into the bounded per-task timeline
-        table (state_ts / phase histograms / straggler bookkeeping)."""
-        with self._lock:
+        table (state_ts / phase histograms / straggler bookkeeping).
+
+        r11: wire batches are handed to the FOLD THREAD through a
+        bounded queue — the fold (dict churn + histogram observes,
+        measured ~15 ms per flush batch at burst) no longer runs on the
+        IO loop, and the flush-ack is issued by the fold thread AFTER
+        ingestion so the ordering barrier holds. Direct calls
+        (conn is None — unit tests) and unstarted heads fold inline.
+        A full queue sheds the batch with drop accounting: observability
+        never backpressures the control plane."""
+        ft = self._fold_thread
+        if conn is None or ft is None or not ft.is_alive():
+            self._ingest_task_events(batch, dropped)
+            if rid > 0 and conn is not None:
+                conn.reply(rid, True)
+            return
+        if len(self._fold_q) >= get_config().task_event_fold_queue_max:
+            with self._timeline_lock:
+                self.task_events_dropped += len(batch) + dropped
+            self.fold_queue_drops += 1
+            if rid > 0:
+                conn.reply(rid, True)  # ack: the batch was consumed (shed)
+            return
+        self._fold_q.append((batch, dropped, conn, rid))
+        self._fold_event.set()
+
+    def _fold_loop(self):
+        """Dedicated fold thread: drains TASK_EVENTS batches in arrival
+        order, folds them under the timeline lock, then acks sync
+        flushes."""
+        q = self._fold_q
+        while not self._shutdown:
+            self._fold_event.wait(0.5)
+            self._fold_event.clear()
+            while q:
+                try:
+                    batch, dropped, conn, rid = q.popleft()
+                except IndexError:
+                    break
+                try:
+                    self._ingest_task_events(batch, dropped)
+                finally:
+                    if rid > 0 and conn is not None:
+                        try:
+                            conn.reply(rid, True)
+                        except P.ConnectionLost:
+                            pass
+
+    def _ingest_task_events(self, batch, dropped):
+        with self._timeline_lock:
             # count HEAD-ring evictions too (the deque drops oldest
             # silently) — the satellite drop counters must cover both
             # the worker buffers and this ring
@@ -2219,17 +2294,14 @@ class Head:
             self.task_events_dropped += dropped + overflow
             for ev in batch:
                 self._fold_task_event(ev)
-        if rid > 0:
-            conn.reply(rid, True)
 
     # --------------------------------------- task timelines / stragglers
 
     def _fold_task_event(self, ev):
         """Fold one task-state event into its timeline row (caller holds
-        the lock). Tolerates the pre-r10 10-field tuple shape (no
-        monotonic stamp: state_ts still fills, phases stay unknown)."""
-        from . import events as E
-
+        the TIMELINE lock). Tolerates the pre-r10 10-field tuple shape
+        (no monotonic stamp: state_ts still fills, phases stay
+        unknown)."""
         tid, name, state, wid, nidx, ts = ev[:6]
         rank = E.STATE_RANK.get(state)
         if rank is None:
@@ -2313,17 +2385,24 @@ class Head:
         row.state_ts.setdefault(state, ts)
         if folded_mono is not None and state not in row.state_mono:
             row.state_mono[state] = folded_mono
-            self._observe_new_phases(row)
+            self._observe_new_phases(row, state)
 
-    def _observe_new_phases(self, row: _TaskTimeline):
+    def _observe_new_phases(self, row: _TaskTimeline, new_state: str):
         """Histogram each phase exactly once, the moment both endpoints
-        are known (caller holds the lock)."""
-        from . import events as E
-
-        for ph, ms in E.derive_phase_ms(row.state_mono).items():
+        are known (caller holds the timeline lock). Incremental: only
+        phases that have ``new_state`` as an endpoint can have newly
+        completed — re-deriving ALL six phases per folded event was a
+        measurable slice of the fold's hot loop."""
+        monos = row.state_mono
+        for ph, starts, ends in E.PHASES_TOUCHING.get(new_state, ()):
             if ph in row.observed:
                 continue
-            if ph == "exec" and E.FINISHED not in row.state_mono:
+            a = E._first_stamp(monos, starts)
+            b = E._first_stamp(monos, ends)
+            if a is None or b is None:
+                continue
+            ms = max(0.0, (b - a) * 1000.0)
+            if ph == "exec" and E.FINISHED not in monos:
                 # a FAILED/CANCELLED attempt's exec time must not seed
                 # the COMPLETED-exec baseline the straggler detector
                 # compares against (5 fast transient failures would arm
@@ -2351,31 +2430,36 @@ class Head:
         metric table (same row schema as _h_metrics_report ingests), so
         the phase histograms ride metrics_summary() / the Prometheus
         exposition (`task_phase_ms_bucket{func=...,phase=...}`) with no
-        extra plumbing. Caller holds the lock."""
+        extra plumbing. Takes the metrics lock itself (callers hold the
+        timeline lock — the fixed ordering)."""
         key = (name, tuple(tags.values()))
-        row = self.metrics.get(key)
-        if row is None:
-            row = self.metrics[key] = {
-                "name": name, "kind": "histogram", "description": desc,
-                "tags": dict(tags),
-                "boundaries": list(TASK_PHASE_MS_BOUNDARIES),
-                "value": [0.0] * (len(TASK_PHASE_MS_BOUNDARIES) + 3),
-            }
-        v = row["value"]
-        for i, b in enumerate(TASK_PHASE_MS_BOUNDARIES):
-            if value_ms <= b:
-                v[i] += 1
-                break
-        else:
-            v[len(TASK_PHASE_MS_BOUNDARIES)] += 1
-        v[-2] += value_ms
-        v[-1] += 1
+        with self._metrics_lock:
+            row = self.metrics.get(key)
+            if row is None:
+                row = self.metrics[key] = {
+                    "name": name, "kind": "histogram",
+                    "description": desc,
+                    "tags": dict(tags),
+                    "boundaries": list(TASK_PHASE_MS_BOUNDARIES),
+                    "value": [0.0] * (len(TASK_PHASE_MS_BOUNDARIES) + 3),
+                }
+            v = row["value"]
+            for i, b in enumerate(TASK_PHASE_MS_BOUNDARIES):
+                if value_ms <= b:
+                    v[i] += 1
+                    break
+            else:
+                v[len(TASK_PHASE_MS_BOUNDARIES)] += 1
+            v[-2] += value_ms
+            v[-1] += 1
 
     def _task_phase_summary(self) -> Dict[str, dict]:
         """{func: {phase: {count, mean_ms, p50_ms, p95_ms, p99_ms}}}
-        from the folded phase histograms (caller holds the lock)."""
+        from the folded phase histograms (takes the metrics lock)."""
         out: Dict[str, dict] = {}
-        for key, row in self.metrics.items():
+        with self._metrics_lock:
+            rows = list(self.metrics.items())
+        for key, row in rows:
             if key[0] != "task.phase_ms":
                 continue
             v, b = row["value"], row["boundaries"]
@@ -2405,7 +2489,7 @@ class Head:
         cfg = get_config()
         now = time.monotonic()
         flagged: List[tuple] = []
-        with self._lock:
+        with self._timeline_lock, self._metrics_lock:
             for row in self.task_timelines.values():
                 if len(flagged) >= 10:
                     # cap the event volume per sweep; the rest stay
@@ -2520,17 +2604,16 @@ class Head:
                    extra: Optional[dict] = None):
         """Head-side cluster event emitter (reference: the GCS writing
         its own node/actor/job transitions into the event log). Safe
-        under self._lock (RLock) — pure in-memory bookkeeping."""
-        from .events import make_cluster_event
-
-        ev = make_cluster_event(severity, source, event_type, message,
-                                node_idx=node_idx, entity_id=entity_id,
-                                extra=extra)
-        with self._lock:
+        from any locked head path — the event ring has its own leaf
+        lock, so emitting never extends a head/shard-lock hold."""
+        ev = E.make_cluster_event(severity, source, event_type, message,
+                                  node_idx=node_idx, entity_id=entity_id,
+                                  extra=extra)
+        with self._cev_lock:
             self._append_cluster_event(ev)
 
     def _append_cluster_event(self, ev: tuple):
-        """Ring append with drop accounting (caller holds the lock) —
+        """Ring append with drop accounting (caller holds _cev_lock) —
         the ONE place the overflow counter is maintained, shared by the
         head's own emitters and CLUSTER_EVENT pushes."""
         if len(self.cluster_events) == self.cluster_events.maxlen:
@@ -2540,7 +2623,7 @@ class Head:
     def _h_cluster_events(self, conn, rid, batch, dropped=0):
         """CLUSTER_EVENT pushes from node agents / workers / the job
         manager merge into the same ring the head's own emitters use."""
-        with self._lock:
+        with self._cev_lock:
             for ev in batch:
                 self._append_cluster_event(tuple(ev))
             self.cluster_events_dropped += dropped
@@ -2549,207 +2632,255 @@ class Head:
 
     def _h_state_query(self, conn, rid, kind, limit):
         """Observability state API (reference: python/ray/util/state/api.py
-        backed by the GCS aggregator endpoints)."""
-        with self._lock:
-            if kind == "nodes":
-                rows = [{
-                    "node_idx": n.idx, "alive": n.alive,
-                    "is_remote": n.is_remote, "node_ip": n.node_ip,
-                    "resources_total": n.resources.total.to_dict(),
-                    "resources_available": n.resources.available.to_dict(),
-                    # last reporter-agent sample for this node (node.*
-                    # gauges; empty until the first telemetry period)
-                    "telemetry": dict(self.node_telemetry.get(n.idx, {})),
-                    # RTT-midpoint (agent_mono - head_mono) estimate used
-                    # to fold this node's event stamps (0 for local
-                    # nodes: CLOCK_MONOTONIC is host-wide)
-                    "clock_offset_s": n.clock_offset_s,
-                    "clock_rtt_s": n.clock_rtt_s,
-                } for n in self.nodes.values()]
-            elif kind == "workers":
-                rows = [{
-                    "worker_id": w.worker_id, "node_idx": n.idx,
-                    "pid": w.pid, "state": w.state,
-                    "actor_id": w.actor_id.hex() if w.actor_id else None,
-                } for n in self.nodes.values()
-                    for w in n.workers.values()]
-            elif kind == "actors":
-                rows = [{
-                    "actor_id": a.actor_id.hex(), "state": a.state,
-                    "name": a.name, "class_name": a.spec.class_name,
-                    "worker_id": a.worker_id, "restarts": a.restarts_used,
-                    "death_cause": a.death_cause,
-                } for a in self.actors.values()]
-            elif kind == "placement_groups":
-                rows = [{
-                    "pg_id": pid.hex(), "state": info.state,
-                    "strategy": info.spec.strategy,
-                    "bundles": [b.resources for b in info.spec.bundles],
-                    "placement": list(info.placement),
-                } for pid, info in self.pgs.items()]
-            elif kind == "objects":
-                rows = [{
-                    "object_id": oid.hex(), "node_idx": loc.node_idx,
-                    "size": loc.size, "owner": loc.owner,
-                    "spilled": bool(loc.spilled_path),
-                    "holders": sorted(loc.holders),
-                } for oid, loc in self.objects.items()
-                    if loc.node_idx >= 0 or loc.spilled_path]
-            elif kind == "object_plane":
-                # object data-plane snapshot: directory shape + locality
-                # placement counters (pull-side counters arrive via the
-                # normal METRICS_REPORT path and land under "metrics")
-                live = [loc for loc in self.objects.values()
-                        if loc.node_idx >= 0 or loc.spilled_path]
-                rows = [{
-                    "directory_objects": len(live),
-                    "directory_bytes": sum(l.size for l in live),
-                    "replicated_objects": sum(
-                        1 for l in live if len(l.holders) > 1),
-                    "holder_entries": sum(len(l.holders) for l in live),
-                    "locality_hits": self.locality_hits,
-                    "locality_misses": self.locality_misses,
-                    "relay_bytes": self.relay_bytes,
-                    # cooperative-broadcast planner state: live
-                    # in-progress locations + cumulative source-role
-                    # assignment / saturation counters (the per-serve
-                    # root-vs-relay counters ride the metrics channel
-                    # as object_plane.serves{role=...})
-                    "inprog_locations": sum(
-                        len(l.inprog) for l in live),
-                    "broadcast_root_assignments":
-                        self.broadcast_root_assignments,
-                    "broadcast_relay_assignments":
-                        self.broadcast_relay_assignments,
-                    "broadcast_fanout_saturations":
-                        self.broadcast_fanout_saturations,
-                    # the head host's own transfer server, split by
-                    # source role (root = sealed copy, relay = re-served
-                    # in-progress partial); agent-side servers report
-                    # the same split via object_plane.serves metrics
-                    "head_server": ({
-                        "pull_requests":
-                            self._transfer_server.pull_requests,
-                        "served_root": self._transfer_server.served_root,
-                        "served_relay":
-                            self._transfer_server.served_relay,
-                        "bytes_served":
-                            self._transfer_server.bytes_served,
-                        "relay_bytes_served":
-                            self._transfer_server.relay_bytes_served,
-                    } if self._transfer_server is not None else {}),
-                }]
-            elif kind == "metrics":
-                # merged client metrics plus the head's own ring-buffer
-                # health counters, so silent event drops surface in
-                # metrics_summary() / the Prometheus exposition
-                rows = list(self.metrics.values()) + [
-                    {"name": "head.task_events_dropped",
-                     "kind": "counter",
-                     "description": "Task events dropped by bounded "
-                                    "buffers (worker + head ring)",
-                     "tags": {}, "boundaries": None,
-                     "value": float(self.task_events_dropped)},
-                    {"name": "head.cluster_events_dropped",
-                     "kind": "counter",
-                     "description": "Cluster events dropped by the head "
-                                    "ring buffer",
-                     "tags": {}, "boundaries": None,
-                     "value": float(self.cluster_events_dropped)},
-                ]
-            elif kind == "io_loop":
-                # head event-loop lag (analog: the reference's
-                # instrumented_io_context / event_stats.h per-handler
-                # timing surfaced through the debug state endpoints) +
-                # ring-buffer drop counters: overflow of the bounded
-                # event buffers must be detectable, not silent
-                rows = [dict(loop=self.io.name, **self.io.stats(),
-                             task_events_dropped=self.task_events_dropped,
-                             cluster_events_dropped=(
-                                 self.cluster_events_dropped),
-                             # this process's data/return-plane fast-path
-                             # counters (vectored sends, coalesced
-                             # flushes, batched completions, zero-copy
-                             # raw bytes) — cluster-wide per-process
-                             # totals ride the metrics channel instead
-                             wire=P.WIRE.snapshot())]
-            elif kind == "cluster_events":
-                # most recent `limit` records, oldest first (the generic
-                # rows[:limit] below then keeps them all)
-                rows = [{
-                    "ts": ts, "severity": sev, "source": src,
-                    "node_idx": nidx, "entity_id": eid, "type": etype,
-                    "message": msg, "extra": extra,
-                } for (ts, sev, src, nidx, eid, etype, msg, extra)
-                    in list(self.cluster_events)[-limit:]]
-            elif kind == "task_events":
-                # raw transition log (timeline/tracing export); tolerant
-                # of the pre-r10 10-field shape (no monotonic stamp)
-                rows = [{
-                    "task_id": ev[0], "name": ev[1], "state": ev[2],
-                    "worker_id": ev[3], "node_idx": ev[4], "ts": ev[5],
-                    "error": ev[6], "trace_id": ev[7], "span_id": ev[8],
-                    "parent_span_id": ev[9],
-                    "mono": ev[10] if len(ev) > 10 else None,
-                } for ev in self.task_events]
-            elif kind == "tasks":
-                # folded timelines, newest activity first: full state_ts
-                # map + derived per-phase latency breakdown per row.
-                # Materialize only `limit` rows — all of this runs under
-                # the head lock, and building 10k fat dicts per
-                # dashboard poll would stall the whole control plane.
-                from . import events as E
-
-                rows = []
-                for r in reversed(self.task_timelines.values()):
-                    if len(rows) >= limit:
-                        break
-                    rows.append({
-                        "task_id": r.task_id, "name": r.name,
-                        "state": r.state, "worker_id": r.worker_id,
-                        "node_idx": r.node_idx, "ts": r.ts,
-                        "error": r.error, "trace_id": r.trace_id,
-                        "state_ts": dict(r.state_ts),
-                        "phase_ms": E.derive_phase_ms(r.state_mono),
-                        "straggler": r.straggler,
-                    })
-            elif kind == "task_summary":
-                # per-func per-phase percentile summary from the folded
-                # phase histograms (`ray summary tasks` parity++), plus
-                # the (name, state) counts computed HERE — summarizing
-                # must not ship every fat timeline row over the RPC
-                # just to count states
-                counts: Dict[str, Dict[str, int]] = {}
-                for r in self.task_timelines.values():
-                    by_state = counts.setdefault(r.name, {})
-                    by_state[r.state] = by_state.get(r.state, 0) + 1
-                rows = [{
-                    "phases": self._task_phase_summary(),
-                    "stragglers_flagged": self.stragglers_flagged,
-                    "slow_nodes_flagged": self.slow_nodes_flagged,
-                    "total": len(self.task_timelines),
-                    "by_func_name": dict(sorted(counts.items())),
-                }]
-            elif kind == "slow_tasks":
-                from . import events as E
-
-                rows = []
-                for r in reversed(self.task_timelines.values()):
-                    if len(rows) >= limit:
-                        break
-                    if not r.straggler:
-                        continue
-                    rows.append({
-                        "task_id": r.task_id, "name": r.name,
-                        "state": r.state, "worker_id": r.worker_id,
-                        "node_idx": r.node_idx,
-                        "running_ms_when_flagged": r.straggler_ms,
-                        "phase_ms": E.derive_phase_ms(r.state_mono),
-                    })
-            else:
-                conn.reply_error(rid, ValueError(f"unknown kind {kind!r}"))
-                return
+        backed by the GCS aggregator endpoints). Each kind takes ONLY
+        the lock that owns its table (head lock for node/actor/PG
+        tables, timeline/metrics/event-ring locks for observability
+        state, per-shard snapshots for the object directory) — a
+        dashboard poll can no longer stall lease granting."""
+        fn = self._STATE_KINDS.get(kind)
+        if fn is None:
+            conn.reply_error(rid, ValueError(f"unknown kind {kind!r}"))
+            return
+        rows = fn(self, limit)
         conn.reply(rid, rows[:limit])
+
+    def _sq_nodes(self, limit):
+        with self._metrics_lock:
+            telemetry = {i: dict(t) for i, t in self.node_telemetry.items()}
+        with self._lock:
+            return [{
+                "node_idx": n.idx, "alive": n.alive,
+                "is_remote": n.is_remote, "node_ip": n.node_ip,
+                "resources_total": n.resources.total.to_dict(),
+                "resources_available": n.resources.available.to_dict(),
+                # last reporter-agent sample for this node (node.*
+                # gauges; empty until the first telemetry period)
+                "telemetry": telemetry.get(n.idx, {}),
+                # RTT-midpoint (agent_mono - head_mono) estimate used
+                # to fold this node's event stamps (0 for local
+                # nodes: CLOCK_MONOTONIC is host-wide)
+                "clock_offset_s": n.clock_offset_s,
+                "clock_rtt_s": n.clock_rtt_s,
+            } for n in self.nodes.values()]
+
+    def _sq_workers(self, limit):
+        with self._lock:
+            return [{
+                "worker_id": w.worker_id, "node_idx": n.idx,
+                "pid": w.pid, "state": w.state,
+                "actor_id": w.actor_id.hex() if w.actor_id else None,
+            } for n in self.nodes.values()
+                for w in n.workers.values()]
+
+    def _sq_actors(self, limit):
+        with self._lock:
+            return [{
+                "actor_id": a.actor_id.hex(), "state": a.state,
+                "name": a.name, "class_name": a.spec.class_name,
+                "worker_id": a.worker_id, "restarts": a.restarts_used,
+                "death_cause": a.death_cause,
+            } for a in self.actors.values()]
+
+    def _sq_placement_groups(self, limit):
+        with self._lock:
+            return [{
+                "pg_id": pid.hex(), "state": info.state,
+                "strategy": info.spec.strategy,
+                "bundles": [b.resources for b in info.spec.bundles],
+                "placement": list(info.placement),
+            } for pid, info in self.pgs.items()]
+
+    def _sq_objects(self, limit):
+        # holder sets copied under the shard locks (a live set can
+        # mutate mid-iteration once the snapshot lock is released)
+        return self.objects.listing_rows()
+
+    def _sq_object_plane(self, limit):
+        # object data-plane snapshot: directory shape + locality
+        # placement counters (pull-side counters arrive via the
+        # normal METRICS_REPORT path and land under "metrics")
+        live = [loc for loc in self.objects.values_snapshot()
+                if loc.node_idx >= 0 or loc.spilled_path]
+        return [{
+            "directory_objects": len(live),
+            "directory_bytes": sum(l.size for l in live),
+            "replicated_objects": sum(
+                1 for l in live if len(l.holders) > 1),
+            "holder_entries": sum(len(l.holders) for l in live),
+            "locality_hits": self.locality_hits,
+            "locality_misses": self.locality_misses,
+            "relay_bytes": self.relay_bytes,
+            # cooperative-broadcast planner state: live
+            # in-progress locations + cumulative source-role
+            # assignment / saturation counters (the per-serve
+            # root-vs-relay counters ride the metrics channel
+            # as object_plane.serves{role=...})
+            "inprog_locations": sum(
+                len(l.inprog) for l in live),
+            "broadcast_root_assignments":
+                self.broadcast_root_assignments,
+            "broadcast_relay_assignments":
+                self.broadcast_relay_assignments,
+            "broadcast_fanout_saturations":
+                self.broadcast_fanout_saturations,
+            # the head host's own transfer server, split by
+            # source role (root = sealed copy, relay = re-served
+            # in-progress partial); agent-side servers report
+            # the same split via object_plane.serves metrics
+            "head_server": ({
+                "pull_requests":
+                    self._transfer_server.pull_requests,
+                "served_root": self._transfer_server.served_root,
+                "served_relay":
+                    self._transfer_server.served_relay,
+                "bytes_served":
+                    self._transfer_server.bytes_served,
+                "relay_bytes_served":
+                    self._transfer_server.relay_bytes_served,
+            } if self._transfer_server is not None else {}),
+        }]
+
+    def _sq_metrics(self, limit):
+        # merged client metrics plus the head's own ring-buffer
+        # health counters, so silent event drops surface in
+        # metrics_summary() / the Prometheus exposition
+        with self._metrics_lock:
+            rows = list(self.metrics.values())
+        return rows + [
+            {"name": "head.task_events_dropped",
+             "kind": "counter",
+             "description": "Task events dropped by bounded "
+                            "buffers (worker + head ring)",
+             "tags": {}, "boundaries": None,
+             "value": float(self.task_events_dropped)},
+            {"name": "head.cluster_events_dropped",
+             "kind": "counter",
+             "description": "Cluster events dropped by the head "
+                            "ring buffer",
+             "tags": {}, "boundaries": None,
+             "value": float(self.cluster_events_dropped)},
+        ]
+
+    def _sq_io_loop(self, limit):
+        # head event-loop lag (analog: the reference's
+        # instrumented_io_context / event_stats.h per-handler
+        # timing surfaced through the debug state endpoints) +
+        # ring-buffer drop counters: overflow of the bounded
+        # event buffers must be detectable, not silent
+        return [dict(loop=self.io.name, **self.io.stats(),
+                     **self.io.lag_stats(),
+                     task_events_dropped=self.task_events_dropped,
+                     cluster_events_dropped=(
+                         self.cluster_events_dropped),
+                     # off-loop fold-queue health: depth right now +
+                     # batches shed because the queue hit its bound
+                     fold_queue_depth=len(self._fold_q),
+                     fold_queue_drops=self.fold_queue_drops,
+                     lease_grant_batches=self.lease_grant_batches,
+                     lease_grants_batched=self.lease_grants_batched,
+                     # this process's data/return-plane fast-path
+                     # counters (vectored sends, coalesced
+                     # flushes, batched completions, zero-copy
+                     # raw bytes) — cluster-wide per-process
+                     # totals ride the metrics channel instead
+                     wire=P.WIRE.snapshot())]
+
+    def _sq_cluster_events(self, limit):
+        # most recent `limit` records, oldest first
+        with self._cev_lock:
+            recent = list(self.cluster_events)[-limit:]
+        return [{
+            "ts": ts, "severity": sev, "source": src,
+            "node_idx": nidx, "entity_id": eid, "type": etype,
+            "message": msg, "extra": extra,
+        } for (ts, sev, src, nidx, eid, etype, msg, extra) in recent]
+
+    def _sq_task_events(self, limit):
+        # raw transition log (timeline/tracing export); tolerant
+        # of the pre-r10 10-field shape (no monotonic stamp)
+        with self._timeline_lock:
+            evs = list(self.task_events)
+        return [{
+            "task_id": ev[0], "name": ev[1], "state": ev[2],
+            "worker_id": ev[3], "node_idx": ev[4], "ts": ev[5],
+            "error": ev[6], "trace_id": ev[7], "span_id": ev[8],
+            "parent_span_id": ev[9],
+            "mono": ev[10] if len(ev) > 10 else None,
+        } for ev in evs]
+
+    def _sq_tasks(self, limit):
+        # folded timelines, newest activity first: full state_ts
+        # map + derived per-phase latency breakdown per row.
+        # Materialize only `limit` rows — building 10k fat dicts
+        # per dashboard poll would stall the fold thread.
+        rows = []
+        with self._timeline_lock:
+            for r in reversed(self.task_timelines.values()):
+                if len(rows) >= limit:
+                    break
+                rows.append({
+                    "task_id": r.task_id, "name": r.name,
+                    "state": r.state, "worker_id": r.worker_id,
+                    "node_idx": r.node_idx, "ts": r.ts,
+                    "error": r.error, "trace_id": r.trace_id,
+                    "state_ts": dict(r.state_ts),
+                    "phase_ms": E.derive_phase_ms(r.state_mono),
+                    "straggler": r.straggler,
+                })
+        return rows
+
+    def _sq_task_summary(self, limit):
+        # per-func per-phase percentile summary from the folded
+        # phase histograms (`ray summary tasks` parity++), plus
+        # the (name, state) counts computed HERE — summarizing
+        # must not ship every fat timeline row over the RPC
+        # just to count states
+        counts: Dict[str, Dict[str, int]] = {}
+        with self._timeline_lock:
+            for r in self.task_timelines.values():
+                by_state = counts.setdefault(r.name, {})
+                by_state[r.state] = by_state.get(r.state, 0) + 1
+            total = len(self.task_timelines)
+        return [{
+            "phases": self._task_phase_summary(),
+            "stragglers_flagged": self.stragglers_flagged,
+            "slow_nodes_flagged": self.slow_nodes_flagged,
+            "total": total,
+            "by_func_name": dict(sorted(counts.items())),
+        }]
+
+    def _sq_slow_tasks(self, limit):
+        rows = []
+        with self._timeline_lock:
+            for r in reversed(self.task_timelines.values()):
+                if len(rows) >= limit:
+                    break
+                if not r.straggler:
+                    continue
+                rows.append({
+                    "task_id": r.task_id, "name": r.name,
+                    "state": r.state, "worker_id": r.worker_id,
+                    "node_idx": r.node_idx,
+                    "running_ms_when_flagged": r.straggler_ms,
+                    "phase_ms": E.derive_phase_ms(r.state_mono),
+                })
+        return rows
+
+    _STATE_KINDS = {
+        "nodes": _sq_nodes,
+        "workers": _sq_workers,
+        "actors": _sq_actors,
+        "placement_groups": _sq_placement_groups,
+        "objects": _sq_objects,
+        "object_plane": _sq_object_plane,
+        "metrics": _sq_metrics,
+        "io_loop": _sq_io_loop,
+        "cluster_events": _sq_cluster_events,
+        "task_events": _sq_task_events,
+        "tasks": _sq_tasks,
+        "task_summary": _sq_task_summary,
+        "slow_tasks": _sq_slow_tasks,
+    }
 
     def _h_node_info(self, conn, rid):
         with self._lock:
@@ -3033,6 +3164,13 @@ class Head:
         self._health_check()
         self._retry_pending_pgs()
         self._try_fulfill_pending()
+        # Loop-lag sampling: a timestamped self-wakeup measures how long
+        # a newly-arrived event waits for the IO thread (the reference's
+        # instrumented_io_context event-stats role). Sampled every
+        # housekeeping tick; published as head.loop_lag_ms{quantile}
+        # gauges so dashboards/scrapers see the control-plane headroom.
+        self.io.probe_lag()
+        self._publish_loop_lag_gauges()
         cfg = get_config()
         now = time.monotonic()
         with self._lock:
@@ -3063,8 +3201,40 @@ class Head:
                             keep.append(wid)
                     node.idle_by_class[cls] = keep
 
+    @property
+    def lost_objects(self):
+        """The directory's LOST-id FIFO (read-only view; kept for the
+        pre-r11 attribute surface — tests and tooling membership-check
+        it)."""
+        return self.objects._lost
+
+    def _publish_loop_lag_gauges(self):
+        """head.loop_lag_ms{quantile=p50|p99} gauges straight into the
+        merged metric table (same direct-write path as the phase
+        histograms) — the SCALE bench gate and doctor_warnings() read
+        these."""
+        lag = self.io.lag_stats()
+        if not lag.get("loop_lag_samples"):
+            return
+        with self._metrics_lock:
+            for q in ("p50", "p99"):
+                key = ("head.loop_lag_ms", (q,))
+                row = self.metrics.get(key)
+                if row is None:
+                    row = self.metrics[key] = {
+                        "name": "head.loop_lag_ms", "kind": "gauge",
+                        "description":
+                            "Head IO-loop lag (self-probe wakeup wait), "
+                            "milliseconds",
+                        "tags": {"quantile": q}, "boundaries": None,
+                        "value": 0.0,
+                    }
+                row["value"] = lag[f"loop_lag_ms_{q}"]
+
     def shutdown(self):
         self._shutdown = True
+        self._fold_event.set()
+        self._dispatch_event.set()
         if self._log_monitor is not None:
             self._log_monitor.stop()
         if self._telemetry is not None:

@@ -70,11 +70,17 @@ class TransferServer:
         # per-chunk pause, settable by tests/chaos tooling to exercise the
         # mid-pull source-failure path deterministically
         self.throttle_s = 0.0
-        # shared-uplink emulation for benches/tests: all concurrent serves
-        # drain ONE token bucket of this many bytes/s (0 = unlimited) —
-        # unlike throttle_s (per-stream pacing), this models a saturated
-        # host NIC, the regime cooperative broadcast exists for
-        self.egress_limit_bps = 0
+        # Per-HOST egress token bucket: every concurrent serve on this
+        # host — all objects, all downstream pullers, root and relay
+        # streams alike — drains this one bucket (0 = unlimited).
+        # Seeded from ``host_egress_limit_bps``: broadcast_fanout's
+        # per-object load accounting cannot stop K concurrent
+        # broadcasts of K DIFFERENT objects stacking K x fanout streams
+        # on one uplink (the r9 caveat); this bucket caps what actually
+        # leaves the NIC no matter how many trees the planner built
+        # through this host. Benches/tests also set it directly for
+        # shared-uplink emulation.
+        self.egress_limit_bps = get_config().host_egress_limit_bps
         self._pace_lock = threading.Lock()
         self._pace_t = 0.0
         # observability: requests served + egress bytes, split by source

@@ -149,6 +149,14 @@ CLUSTER_EVENT = 71      # ([(ts, severity, source, node_idx, entity_id,
                         # the GCS cluster event log behind
                         # `ray list cluster-events`); one-way from any
                         # process, mirroring the task-event channel
+LEASE_GRANT_BATCH = 73  # head->driver, one-way: ([(rid, worker_id,
+                        # listen_addr, lease_id, tpu_ids)],) — the
+                        # request-side mirror of TASK_DONE_BATCH: one
+                        # batched dispatch pass that granted several of a
+                        # driver's queued LEASE_REQUESTs acks them all in
+                        # ONE frame (one pickle, one syscall) instead of
+                        # a LEASE_REPLY per lease; the driver completes
+                        # each rid's blocked call from the batch
 OBJ_PULL_FAIL = 72      # server->puller: (oid_bin, offset) — the server
                         # cannot complete the requested range past
                         # `offset` (its own in-progress pull aborted, or
@@ -570,6 +578,20 @@ class Connection:
                         else pickle.loads(payload))
         return msgs
 
+    def complete_reply(self, rid: int, fields: tuple) -> bool:
+        """Complete a pending call() as if a normal reply for ``rid``
+        arrived — the delivery path for BATCHED replies (e.g.
+        LEASE_GRANT_BATCH), where one frame carries many requests'
+        results and the receiver fans them out. Returns False when no
+        call is waiting (requester gave up)."""
+        with self._pending_lock:
+            w = self._pending.get(rid)
+        if w is None:
+            return False
+        w.value = tuple(fields)
+        w.event.set()
+        return True
+
     def dispatch_reply(self, msg) -> bool:
         """If msg is a reply to a pending call, complete it. Returns True."""
         request_id = msg[1]
@@ -651,6 +673,13 @@ class IOLoop:
         self._events = 0
         self._slow_events = 0
         self._max_handler_s = 0.0
+        # self-probe loop lag (probe_lag()/lag_stats()): a timestamped
+        # wakeup measures how long a new event waits for this thread —
+        # the direct "is the loop off the hot path" gauge (analog:
+        # instrumented_io_context's queued-time stats). One probe in
+        # flight at a time; samples ring-buffered for the quantiles.
+        self._lag_probe_t: Optional[float] = None
+        self._lag_samples: deque = deque(maxlen=256)
 
     def start(self):
         if not self._started:
@@ -699,6 +728,11 @@ class IOLoop:
                         self._wakeup_r.recv(4096)
                     except OSError:
                         pass
+                    sent = self._lag_probe_t
+                    if sent is not None:
+                        self._lag_probe_t = None
+                        self._lag_samples.append(
+                            time.perf_counter() - sent)
                 elif kind == "listen":
                     try:
                         client, addr = key.fileobj.accept()
@@ -739,6 +773,30 @@ class IOLoop:
                 "busy_s": round(self._busy_s, 3),
                 "slow_events": self._slow_events,
                 "max_handler_s": round(self._max_handler_s, 4)}
+
+    def probe_lag(self):
+        """Launch one loop-lag probe: stamp now, wake the loop, and let
+        the wakeup handler record how long the wake waited. No-op while
+        a probe is already in flight (a wedged loop then simply keeps
+        its worst sample instead of stacking probes)."""
+        if self._lag_probe_t is None and self._started:
+            self._lag_probe_t = time.perf_counter()
+            self._wake()
+
+    def lag_stats(self) -> dict:
+        """p50/p99/max of the recent self-probe lag samples, in ms."""
+        samples = sorted(self._lag_samples)
+        n = len(samples)
+        if not n:
+            return {"loop_lag_samples": 0, "loop_lag_ms_p50": 0.0,
+                    "loop_lag_ms_p99": 0.0, "loop_lag_ms_max": 0.0}
+        return {
+            "loop_lag_samples": n,
+            "loop_lag_ms_p50": round(samples[n // 2] * 1e3, 3),
+            "loop_lag_ms_p99": round(
+                samples[min(n - 1, (n * 99) // 100)] * 1e3, 3),
+            "loop_lag_ms_max": round(samples[-1] * 1e3, 3),
+        }
 
     def _service_conn(self, sock, on_message, conn: Connection):
         try:

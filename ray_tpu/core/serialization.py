@@ -42,26 +42,51 @@ class SerializedValue:
         return sum(len(f) for f in self.frames)
 
 
+_ref_cls = None  # lazy: object_ref imports back into core modules
+
+
+class _RefCollectingPickler(cloudpickle.CloudPickler):
+    """Module-level pickler subclass: defining this class INSIDE
+    serialize() (the old shape) cost ~20 us of class creation per call
+    — the dominant cost of serializing a small task result."""
+
+    def __init__(self, file, buffer_callback, contained_refs):
+        super().__init__(file, protocol=5,
+                         buffer_callback=buffer_callback)
+        self._contained_refs = contained_refs
+
+    def persistent_id(self, obj):
+        return None
+
+    def reducer_override(self, obj):
+        if isinstance(obj, _ref_cls):
+            self._contained_refs.append(obj)
+            return (_ref_cls._deserialize, (obj.id.binary(), obj.owner))
+        # delegate (NOT NotImplemented): cloudpickle's own
+        # reducer_override is what pickles closures/lambdas by value
+        return super().reducer_override(obj)
+
+
+# Types that can never contain an ObjectRef or need out-of-band
+# buffers: stock-pickled in one shot, skipping the BytesIO +
+# CloudPickler machinery entirely (a no-op task's `return 0` is THE
+# common small result at high task rates).
+_SCALAR_TYPES = (type(None), bool, int, float)
+
+
 def serialize(value: Any) -> SerializedValue:
+    t = type(value)
+    if t in _SCALAR_TYPES or (t is bytes or t is str) and len(value) < 8192:
+        return SerializedValue([pickle.dumps(value, protocol=5)], [])
+    global _ref_cls
+    if _ref_cls is None:
+        from .object_ref import ObjectRef as _ref_cls_  # noqa: N813
+
+        _ref_cls = _ref_cls_
     buffers: List[pickle.PickleBuffer] = []
     contained_refs: List[Any] = []
-
-    from .object_ref import ObjectRef
-
-    class _Pickler(cloudpickle.CloudPickler):
-        def persistent_id(self, obj):
-            return None
-
-        def reducer_override(self, obj):
-            if isinstance(obj, ObjectRef):
-                contained_refs.append(obj)
-                return (ObjectRef._deserialize, (obj.id.binary(), obj.owner))
-            # delegate (NOT NotImplemented): cloudpickle's own
-            # reducer_override is what pickles closures/lambdas by value
-            return super().reducer_override(obj)
-
     sio = io.BytesIO()
-    p = _Pickler(sio, protocol=5, buffer_callback=buffers.append)
+    p = _RefCollectingPickler(sio, buffers.append, contained_refs)
     p.dump(value)
     # getbuffer(), not getvalue(): the pickle stream stays a zero-copy
     # view of the BytesIO's internal buffer. For in-band-heavy values

@@ -196,13 +196,23 @@ DOCTOR_ENDPOINTS = (
 )
 
 
+# Head IO-loop lag p99 above this is a wedged-control-plane signal
+# (every lease grant / locate / state query on that host waits at least
+# this long for the loop): warn, pointing at the usual culprits.
+LOOP_LAG_WARN_MS = 250.0
+
+
 def doctor_warnings() -> list:
     """Health warnings that are not endpoint failures: nonzero
     ``task_events_dropped`` / ``cluster_events_dropped`` mean the
     bounded event buffers overflowed — the task timelines and event log
     are silently missing transitions, which blinds the phase breakdown
-    and straggler detector. Returns human-readable warning strings
-    (empty on a healthy cluster)."""
+    and straggler detector; ``fold_queue_drops`` means whole TASK_EVENTS
+    batches were shed before folding (same blindness, different
+    buffer); a high ``loop_lag_ms_p99`` means the head IO loop itself
+    is not keeping up — every control-plane RPC queues behind it.
+    Returns human-readable warning strings (empty on a healthy
+    cluster)."""
     from ray_tpu import state
 
     warns = []
@@ -213,6 +223,8 @@ def doctor_warnings() -> list:
     for row in rows:
         td = row.get("task_events_dropped", 0)
         cd = row.get("cluster_events_dropped", 0)
+        fd = row.get("fold_queue_drops", 0)
+        lag = row.get("loop_lag_ms_p99", 0.0)
         if td:
             warns.append(
                 f"task_events_dropped={td}: task timelines are missing "
@@ -223,6 +235,18 @@ def doctor_warnings() -> list:
                 f"cluster_events_dropped={cd}: the cluster event log "
                 "overflowed and lost records — raise "
                 "cluster_event_buffer_size")
+        if fd:
+            warns.append(
+                f"fold_queue_drops={fd}: the head shed whole TASK_EVENTS "
+                "batches before folding (timelines are missing tasks) — "
+                "raise task_event_fold_queue_max or investigate fold-"
+                "thread starvation")
+        if lag > LOOP_LAG_WARN_MS:
+            warns.append(
+                f"loop_lag_ms_p99={lag:.0f}: the head IO loop is behind "
+                f"(> {LOOP_LAG_WARN_MS:.0f}ms p99) — every control-plane "
+                "RPC queues behind it; look for slow handlers "
+                "(slow_events / max_handler_s in io_loop state)")
     return warns
 
 

@@ -155,10 +155,20 @@ class GrpcProxy:
                         routes = ray_tpu.get(
                             self._controller_handle().get_routes.remote(),
                             timeout=10)
-                        self._apps = {app: prefix
-                                      for prefix, app in routes.items()}
+                        old = self._apps
+                        new = {app: prefix
+                               for prefix, app in routes.items()}
+                        # Invalidate ONLY handles whose app's route
+                        # actually changed (redeploy/removal) — dropping
+                        # the whole cache every 1s refresh made the next
+                        # Predict per app pay a blocking get_ingress
+                        # controller RPC every second under steady
+                        # traffic (ADVICE.md finding).
+                        self._handles = {
+                            a: h for a, h in self._handles.items()
+                            if a in new and new[a] == old.get(a)}
+                        self._apps = new
                         self._apps_at = time.monotonic()
-                        self._handles = {}
                 except Exception:  # noqa: BLE001 — keep serving stale
                     pass
                 finally:

@@ -99,7 +99,7 @@ class ServeReplica:
         its iterator, return a stream id the client drains with
         stream_next (ref: replica.py:339 streaming generator support).
         The stream counts as one ongoing request until it ends."""
-        import uuid
+        from ray_tpu.core.ids import _random_bytes
 
         from .multiplex import _set_request_model_id
 
@@ -109,7 +109,7 @@ class ServeReplica:
         finally:
             _set_request_model_id("")
         it = iter(result)
-        sid = uuid.uuid4().hex[:16]
+        sid = _random_bytes(8).hex()  # pooled entropy: per-request path
         with self._lock:
             self._ongoing += 1
             self._streams[sid] = (it, meta or {})

@@ -110,15 +110,19 @@ def object_plane_stats() -> Dict[str, Any]:
 
 
 def io_loop_stats() -> List[Dict[str, Any]]:
-    """Head event-loop lag counters (analog: the reference's
+    """Head event-loop health (analog: the reference's
     instrumented_io_context / event_stats.h per-handler timing):
-    events handled, busy seconds, slow-handler episodes, worst
-    handler time — plus the head ring-buffer drop counters
-    (``task_events_dropped`` / ``cluster_events_dropped``), so silent
-    event-buffer overflow is detectable, and the head process's wire
-    fast-path counters (``wire``: vectored sendmsg calls, frames
-    coalesced, batched completions, zero-copy bytes, backpressure
-    hits); cluster-wide per-process wire totals are the ``wire.*``
+    events handled, busy seconds, slow-handler episodes, worst handler
+    time, plus the r11 self-probe loop-lag quantiles
+    (``loop_lag_ms_p50/p99/max`` — how long a fresh event waits for the
+    IO thread; also published as ``head.loop_lag_ms`` gauges), the
+    off-loop fold-queue health (``fold_queue_depth`` /
+    ``fold_queue_drops``), the batched-lease counters
+    (``lease_grant_batches`` / ``lease_grants_batched``), the head
+    ring-buffer drop counters (``task_events_dropped`` /
+    ``cluster_events_dropped``) so silent event-buffer overflow is
+    detectable, and the head process's wire fast-path counters
+    (``wire``); cluster-wide per-process wire totals are the ``wire.*``
     rows in ``metrics_summary()`` instead."""
     return _query("io_loop", 10)
 

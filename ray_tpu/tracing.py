@@ -20,11 +20,11 @@ and nests under it — ``timeline()`` exposes the ids via each event's
 from __future__ import annotations
 
 import json
-import uuid
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
 from .core import events as _ev
+from .core.ids import _random_bytes
 from .core import protocol as P
 from .core.context import get_context
 
@@ -52,7 +52,7 @@ def span(name: str):
     """
     ctx = get_context()
     parent = _ev.current_trace()
-    trace_id = parent[0] if parent else uuid.uuid4().hex
+    trace_id = parent[0] if parent else _random_bytes(16).hex()
     parent_id = parent[1] if parent else ""
     span_id = _ev.new_span_id()
     ctx.events.record(span_id, name, SPAN_START, trace_id=trace_id,

@@ -481,6 +481,65 @@ def test_non_relay_pull_of_missing_object_still_fails_fast(xfer):
     assert _fetch_bytes(sc, oid) == payload
 
 
+def test_host_egress_bucket_bounds_concurrent_broadcasts(xfer):
+    """Two concurrent pulls of DISTINCT objects from one holder drain
+    ONE host-wide token bucket (r11 ``host_egress_limit_bps``): the r9
+    fanout accounting is per-object, so K broadcasts of K objects could
+    stack K x fanout streams on one uplink — the bucket caps what
+    actually leaves the host, measured here as total wall time >=
+    total_bytes / limit."""
+    (sa, srv_a, _pa) = xfer()
+    (sb, _srv_b, pull_b) = xfer()
+    (sc, _srv_c, pull_c) = xfer()
+    size = 3 * 1024 * 1024
+    o1, o2 = ObjectID.from_random(), ObjectID.from_random()
+    p1, p2 = _payload(size, seed=21), _payload(size, seed=22)
+    _seed(sa, o1, p1)
+    _seed(sa, o2, p2)
+    limit = 8 * 1024 * 1024  # bytes/s, shared across BOTH streams
+    srv_a.egress_limit_bps = limit
+    res = {}
+    threads = [
+        threading.Thread(target=lambda: res.setdefault(
+            "b", pull_b.pull(o1, [srv_a.addr], timeout=60,
+                             size_hint=size))),
+        threading.Thread(target=lambda: res.setdefault(
+            "c", pull_c.pull(o2, [srv_a.addr], timeout=60,
+                             size_hint=size))),
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    wall = time.monotonic() - t0
+    assert res.get("b") is True and res.get("c") is True
+    assert _fetch_bytes(sb, o1) == p1
+    assert _fetch_bytes(sc, o2) == p2
+    # 6 MiB total through an 8 MiB/s host bucket: the strict floor is
+    # 0.75s; allow scheduling slack but fail anything near the
+    # unpaced wall time (two streams at full speed finish in ~0.1s)
+    assert wall >= 0.8 * (2 * size) / limit, \
+        f"host egress exceeded the bucket: {wall:.2f}s wall"
+
+
+def test_host_egress_bucket_seeded_from_config(xfer):
+    """TransferServer picks up ``host_egress_limit_bps`` at creation
+    (benches/tests may still override the attribute directly)."""
+    (_s, srv, _p) = xfer()
+    cfg = get_config()
+    old = cfg.host_egress_limit_bps
+    cfg.host_egress_limit_bps = 123456
+    try:
+        srv2 = TransferServer(srv._io, lambda oid: None,
+                              advertise_ip="127.0.0.1")
+        assert srv2.egress_limit_bps == 123456
+        srv2.close()
+    finally:
+        cfg.host_egress_limit_bps = old
+    assert srv.egress_limit_bps == 0  # default: unpaced
+
+
 # ------------------------------------------------- head fan-out planner
 
 
