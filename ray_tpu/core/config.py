@@ -174,6 +174,28 @@ class Config:
     # Durable head WAL (reference: GCS Redis-backed store client —
     # redis_store_client.h). Restores KV / named actors / PGs on restart.
     head_persistence: bool = True
+    # Head fault tolerance (reference: GCS FT —
+    # gcs_rpc_server_reconnect_timeout_s, ray_config_def.h): how long a
+    # node agent / driver / worker keeps retrying its head channel after
+    # a ConnectionLost before giving up with the pre-r12 fail-fast error
+    # (agents shut down, workers exit, driver calls raise). While
+    # reconnecting, writes park and in-flight call()s are replayed after
+    # reattach with their original request ids — the head's
+    # (client_id, request_id) dedupe map keeps a retried mutation that
+    # already landed from applying twice. A head restarted on the same
+    # address/session dir within this window is a recoverable event: the
+    # cluster re-registers instead of dying.
+    head_reconnect_timeout_s: float = 30.0
+    # Bootstrap grace window of a RESTARTED head (same session dir => WAL
+    # records found): lease granting, restored-actor/PG rescheduling and
+    # the straggler/slow-node detectors hold for up to this long while
+    # node agents / workers re-register, so the head never schedules
+    # against a half-empty node table or double-schedules an actor whose
+    # surviving worker is about to reclaim it. The window lifts EARLY
+    # once at least one node is present and no new registration has
+    # landed for 0.5s (re-registrations arrive in a burst right after
+    # the head comes back). Fresh sessions (no WAL records) pay nothing.
+    head_restart_grace_s: float = 5.0
     # OOM control (reference: memory_monitor.h:52 — 0.95 threshold,
     # 250ms refresh). refresh <= 0 disables the monitor.
     memory_usage_threshold: float = 0.95

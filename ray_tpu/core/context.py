@@ -183,9 +183,17 @@ class CoreContext:
             self._listener = P.listen_unix(self.listen_path)
         self.io.add_listener(self._listener, self._on_accept)
 
-        # Head connection (GCS + raylet client).
-        sock = P.connect_addr(head_addr)
-        self.head = P.Connection(sock, peer="head")
+        # Head connection (GCS + raylet client). Reconnecting (GCS-FT
+        # analog: workers and drivers keep their GCS channel across a
+        # gcs_server restart): on ConnectionLost the channel re-dials
+        # with backoff up to head_reconnect_timeout_s, re-registers this
+        # process (re-claiming its actor identity if it hosts one),
+        # re-subscribes pubsub channels, and replays parked call()s —
+        # only past the deadline does on_close fire with the old
+        # fail-fast semantics (workers exit; driver calls raise).
+        self.head = P.ReconnectingConnection(
+            head_addr, client_id=self.worker_id, peer="head",
+            on_reattach=self._on_head_reattach)
         self.head.on_close = self._on_head_close
         self.io.add_connection(self.head, self._on_head_message)
         self.io.start()
@@ -328,9 +336,55 @@ class CoreContext:
             pass
 
     def _on_head_close(self, conn):
+        # fires only once the reconnecting channel gives up (reconnect
+        # window expired) or on deliberate shutdown — transient head
+        # loss within head_reconnect_timeout_s never reaches here
         if not self._shutdown and not self.is_driver:
             # head gone — worker exits (reference: raylet death kills workers)
             os._exit(1)
+
+    def _on_head_reattach(self, conn):
+        """Reconnector-thread hook: the head channel came back — the
+        peer may be a RESTARTED head with empty worker/actor tables.
+        Re-register this process (with its actor spec, so a surviving
+        actor worker re-claims its identity and named actors keep their
+        state), re-subscribe every pubsub channel, and nudge the
+        submitter so queued work re-requests leases. Runs BEFORE parked
+        senders and replayed call()s resume.
+
+        The node this process lives on may itself still be
+        re-registering (its agent races us on an independent channel):
+        REGISTER is retried while the head answers "no node"."""
+        if self._shutdown:
+            return
+        aspec = None
+        if self._actor_spec is not None:
+            from .serialization import dumps as _dumps
+
+            aspec = _dumps(self._actor_spec)
+        deadline = time.monotonic() + \
+            get_config().head_reconnect_timeout_s
+        while True:
+            try:
+                conn.call(P.REGISTER, self.worker_id, os.getpid(),
+                          self.listen_addr, self.node_idx, aspec,
+                          timeout=10)
+                break
+            except P.ConnectionLost:
+                raise  # socket died again: the reconnector retries
+            except Exception:
+                # most likely "no node N" — our agent hasn't finished
+                # its own re-registration yet
+                if time.monotonic() > deadline or self._shutdown:
+                    raise
+                time.sleep(0.2)
+        with self._pub_lock:
+            channels = list(self._pub_handlers)
+        for ch in channels:
+            conn.send(P.SUBSCRIBE, ch)
+        ev = getattr(self, "_submit_event", None)
+        if ev is not None:  # a reattach can race __init__'s tail
+            ev.set()
 
     def subscribe(self, channel: str, handler, *, ack: bool = True):
         """``ack=False`` sends the subscription one-way — frames on this

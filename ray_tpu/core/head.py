@@ -170,6 +170,59 @@ def _hist_quantile(bounds, value, q: float) -> float:
     return float(bounds[-1])
 
 
+# Request types whose retried copies the head dedupes by
+# (client_id, request_id): the control-plane MUTATIONS a reconnecting
+# channel may replay after a reattach. Reads are naturally idempotent
+# and lease requests have their own orphan-grant return path.
+_DEDUPE_TYPES = frozenset((
+    P.KV_PUT, P.KV_DEL, P.CREATE_ACTOR, P.CREATE_PG, P.REMOVE_PG,
+    P.KILL_ACTOR,
+))
+# WAL-durable subset: their dedupe keys persist alongside the mutation,
+# so a retry that crosses a head CRASH is re-acked, not re-applied.
+_DEDUPE_DURABLE = frozenset((
+    P.KV_PUT, P.KV_DEL, P.CREATE_ACTOR, P.CREATE_PG, P.REMOVE_PG,
+))
+# Generic success acks for WAL-restored dedupe entries (the mutation
+# landed before the crash; the original reply's exact content is gone).
+_DEDUPE_GENERIC = {
+    P.KV_PUT: (P.OK, (True,)),
+    P.KV_DEL: (P.OK, (True,)),
+    P.CREATE_ACTOR: (P.CREATE_ACTOR_REPLY, (True,)),
+    P.CREATE_PG: (P.CREATE_PG_REPLY, ("CREATED",)),
+    P.REMOVE_PG: (P.OK, (True,)),
+    P.KILL_ACTOR: (P.OK, (True,)),
+}
+_DEDUPE_CAP = 4096
+
+
+class _DedupeRecorder:
+    """Connection proxy handed to deduped handlers: success replies are
+    recorded under the request's (client_id, rid) key — and, for
+    durable mutations, a ``("dedupe", ...)`` WAL record rides along —
+    before forwarding to the real connection. Error replies are NOT
+    recorded (a retry may legitimately succeed)."""
+
+    __slots__ = ("_head", "_conn", "_key", "_mt")
+
+    def __init__(self, head: "Head", conn, key, mt: int):
+        self._head = head
+        self._conn = conn
+        self._key = key
+        self._mt = mt
+
+    def __getattr__(self, name):
+        return getattr(self._conn, name)
+
+    def reply(self, request_id, *fields, msg_type=P.OK):
+        self._head._record_dedupe(self._key, self._mt,
+                                  (msg_type, fields))
+        self._conn.reply(request_id, *fields, msg_type=msg_type)
+
+    def reply_error(self, request_id, err):
+        self._conn.reply_error(request_id, err)
+
+
 class Head:
     def __init__(self, session_dir: str, session_name: str):
         self.session_dir = session_dir
@@ -295,6 +348,26 @@ class Head:
         # auto-names for actors created by non-Python frontends
         self._xlang_actor_seq = itertools.count()
         self._log_monitor = None
+        # --- head fault tolerance (r12, the GCS-FT analog) ---
+        # (client_id, request_id) -> cached success reply for retried
+        # mutations: a reconnecting channel replays in-flight requests
+        # after reattach with their ORIGINAL rids, and a mutation that
+        # already landed must be re-acked, not re-applied. None values
+        # are WAL-restored entries ("applied before the crash, reply
+        # unknown") answered with the generic per-type ack.
+        self._dedupe: "OrderedDict[tuple, Optional[tuple]]" = OrderedDict()
+        self._dedupe_lock = threading.Lock()
+        self.dedupe_hits = 0
+        self.client_reconnects = 0   # CLIENT_HELLO reattach=True count
+        self._reconnect_clients: set = set()  # distinct reattaching ids
+        self.node_reattaches = 0     # REGISTER_NODE with a prior node id
+        self.actor_reclaims = 0      # surviving actor workers re-claimed
+        # bootstrap grace window of a restarted head: set below when the
+        # WAL shows a previous incarnation; while active, lease granting
+        # and the detectors hold so re-registrations can stream in
+        self._grace_until = 0.0
+        self._grace_reported = False
+        self._last_node_reg_ts = time.monotonic()
         # Durable control-plane WAL (reference: GCS Redis store client).
         self._persist: Optional[HeadStore] = None
         self._wal_backlog: List[tuple] = []  # records queued under _lock
@@ -307,6 +380,20 @@ class Head:
                 self.kv = {ns: dict(t) for ns, t in state["kv"].items()}
                 self._restored_actor_specs = list(state["actors"].values())
                 self._restored_pg_specs = list(state["pgs"].values())
+                for key in state.get("dedupe", ()):
+                    self._dedupe[tuple(key)] = None
+                self._grace_until = (time.monotonic()
+                                     + get_config().head_restart_grace_s)
+                self.emit_event(
+                    "WARNING", "head", "head_restarted",
+                    f"head restarted from WAL in {session_dir} "
+                    f"(holding scheduling up to "
+                    f"{get_config().head_restart_grace_s:g}s for "
+                    "re-registrations)",
+                    extra={"restored_kv_namespaces": len(self.kv),
+                           "restored_actors":
+                               len(self._restored_actor_specs),
+                           "restored_pgs": len(self._restored_pg_specs)})
 
     def start(self):
         self.io.start()
@@ -492,17 +579,65 @@ class Head:
         with self._lock:
             self.nodes[idx] = node
             self.scheduler.add_node(idx, nr)
+            self._last_node_reg_ts = time.monotonic()
         self.emit_event("INFO", "head", "node_registered",
                         f"local node {idx} registered", node_idx=idx,
                         extra={"resources": nr.total.to_dict()})
         self._flush_restored()
         return idx
 
+    def _grace_active(self) -> bool:
+        """Restarted head's SCHEDULING holdback: True while lease
+        granting and the detectors must wait for re-registrations.
+        Lifts at ``head_restart_grace_s``, or EARLY once at least one
+        node is registered and no node/worker registration has landed
+        for 0.5s (reattaches arrive in a burst — the quiet period marks
+        the stream's end, so an embedded restart pays ~0.5s instead of
+        the full window). The restored-entity flush holdback
+        (``_flush_restored``) deliberately does NOT lift early: a
+        surviving actor worker's reclaim may trail the node burst by a
+        couple of backoff rounds, and a WAL reschedule racing it would
+        fork a fresh actor that shadows the live one."""
+        gu = self._grace_until
+        if not gu:
+            return False
+        now = time.monotonic()
+        if now >= gu:
+            self._grace_until = 0.0
+            self._report_grace_end()
+            return False
+        if self.nodes and now - self._last_node_reg_ts >= 0.5:
+            # scheduling resumes early; _grace_until stays set so the
+            # restored-entity flush still waits out the full window.
+            # No dispatcher kick here: this is routinely observed from
+            # INSIDE a dispatch pass (via _try_grant_locked), and the
+            # dispatcher's 0.25s tick resumes queued leases anyway.
+            self._report_grace_end()
+            return False
+        return True
+
+    def _report_grace_end(self):
+        if self._grace_reported:
+            return
+        self._grace_reported = True
+        self.emit_event(
+            "INFO", "head", "head_grace_ended",
+            f"restart grace window ended with {len(self.nodes)} nodes "
+            f"({self.node_reattaches} reattached); scheduling resumed",
+            extra={"nodes": len(self.nodes),
+                   "node_reattaches": self.node_reattaches})
+
     def _flush_restored(self):
         """Reschedule durable entities replayed from a previous head's WAL,
         now that a node exists to place them on (reference: GCS failover
         reschedules detached actors / placement groups from the Redis
-        tables — gcs_actor_manager.cc, gcs_placement_group_manager.cc)."""
+        tables — gcs_actor_manager.cc, gcs_placement_group_manager.cc).
+        Held back for the FULL restart grace window: a surviving actor
+        worker re-claiming its actor must win over a fresh reschedule of
+        the same WAL spec (the reclaim empties the spec from the
+        restored list, making this a no-op for it)."""
+        if self._grace_until and time.monotonic() < self._grace_until:
+            return  # periodic() retries once the window expires
         with self._lock:
             pg_specs, self._restored_pg_specs = self._restored_pg_specs, []
             a_specs, self._restored_actor_specs = \
@@ -531,28 +666,114 @@ class Head:
                     self.named_actors[info.name] = spec.actor_id
             self._schedule_actor(info)
 
+    # scheduling class assigned to workers recreated from an agent's
+    # re-registration report: they re-enter the idle pool under it when
+    # their own REGISTER lands, so the repurpose-across-classes path can
+    # lease them again instead of forking fresh interpreters
+    REATTACH_CLASS = ("_reattached",)
+
     def register_remote_node(self, conn: P.Connection, resources,
                              store_name: str, node_ip: str,
                              session_dir: str,
-                             transfer_addr: str = "") -> int:
+                             transfer_addr: str = "",
+                             prior_idx: int = -1, worker_ids=(),
+                             holder_report=()) -> int:
         """A node agent on another host joins over TCP (the reference's
-        raylet registration with the GCS, gcs_node_manager.cc)."""
+        raylet registration with the GCS, gcs_node_manager.cc).
+
+        Re-registration (GCS-FT analog: raylets re-register after a
+        gcs_server restart): a reattaching agent sends its PRIOR node
+        id, its live worker set, and a full object-store holder report.
+        The head keeps (or recreates) the node under the same index,
+        recreates the reported workers as ``starting`` entries (each
+        flips to a leasable idle worker when its own REGISTER arrives),
+        and rebuilds the — deliberately non-WAL'd — object directory
+        from holder truth."""
+        reattached = False
         with self._lock:
-            idx = self._next_node_idx
-            self._next_node_idx += 1
-            node = NodeState(idx=idx, resources=resources, store=None,
-                             store_name=store_name, agent_conn=conn,
-                             node_ip=node_ip, session_dir=session_dir,
-                             transfer_addr=transfer_addr)
-            self.nodes[idx] = node
-            self.scheduler.add_node(idx, resources)
+            # Idempotent per connection: a reconnecting agent's reattach
+            # hook re-registers AND its original in-flight REGISTER_NODE
+            # (no prior idx yet) may be replayed afterwards on the same
+            # socket — the second request must return the same node, not
+            # mint a ghost entry that double-counts the host's resources.
+            prev = getattr(conn, "_registered_node_idx", None)
+            if prev is not None:
+                existing = self.nodes.get(prev)
+                if existing is not None and \
+                        existing.store_name == store_name:
+                    return prev
+            node = None
+            if prior_idx >= 0:
+                existing = self.nodes.get(prior_idx)
+                if existing is not None and \
+                        existing.store_name == store_name:
+                    # brief socket loss, head never evicted the node:
+                    # swap the channel in place
+                    node = existing
+                    old = node.agent_conn
+                    if old is not None and old is not conn:
+                        old.on_close = None
+                        old.close()
+                    node.agent_conn = conn
+                    node.alive = True
+                    node.health_failures = 0
+                    idx = prior_idx
+                    reattached = True
+                elif existing is None:
+                    # restarted head: the table died with it — recreate
+                    # the node under its prior index so worker env vars
+                    # and directory reports stay coherent
+                    idx = prior_idx
+                    self._next_node_idx = max(self._next_node_idx,
+                                              prior_idx + 1)
+                    reattached = True
+                # else: index collision with a different store (prior
+                # idx recycled) — fall through to a fresh index
+            if node is None:
+                if not reattached:
+                    idx = self._next_node_idx
+                    self._next_node_idx += 1
+                node = NodeState(idx=idx, resources=resources, store=None,
+                                 store_name=store_name, agent_conn=conn,
+                                 node_ip=node_ip, session_dir=session_dir,
+                                 transfer_addr=transfer_addr)
+                self.nodes[idx] = node
+                self.scheduler.add_node(idx, resources)
+            now = time.monotonic()
+            self._last_node_reg_ts = now
+            conn._registered_node_idx = idx
+            if reattached:
+                self.node_reattaches += 1
+                for wid in worker_ids:
+                    if wid in node.workers:
+                        continue
+                    node.workers[wid] = WorkerInfo(
+                        worker_id=wid, node_idx=idx,
+                        sched_class=self.REATTACH_CLASS, spawned_at=now)
         conn.peer = f"agent:node{idx}"
         conn.on_close = lambda c, i=idx: self._on_agent_close(i)
-        self.emit_event("INFO", "head", "node_registered",
-                        f"remote node {idx} joined from {node_ip}",
-                        node_idx=idx,
-                        extra={"node_ip": node_ip,
-                               "resources": resources.total.to_dict()})
+        # holder truth -> object directory (off the head lock: the
+        # directory has its own shard locks). Answers any locates that
+        # were already parked by reconnected drivers.
+        for ob, size in holder_report:
+            self._directory_add(ObjectID(ob), idx, int(size))
+        if reattached:
+            self.emit_event(
+                "INFO", "head", "node_reattached",
+                f"node {idx} re-registered from {node_ip} "
+                f"({len(worker_ids)} live workers, "
+                f"{len(holder_report)} held objects reported)",
+                node_idx=idx,
+                extra={"node_ip": node_ip,
+                       "live_workers": len(worker_ids),
+                       "held_objects": len(holder_report)})
+        else:
+            self.emit_event("INFO", "head", "node_registered",
+                            f"remote node {idx} joined from {node_ip}",
+                            node_idx=idx,
+                            extra={"node_ip": node_ip,
+                                   "resources":
+                                       resources.total.to_dict()})
         self._publish("node_added", dumps(idx))
         self._flush_restored()
         return idx
@@ -563,9 +784,13 @@ class Head:
             self.remove_node(idx, kill_workers=True)
 
     def _h_register_node(self, conn, rid, resources, store_name, node_ip,
-                         session_dir, transfer_addr=""):
+                         session_dir, transfer_addr="", prior_idx=-1,
+                         worker_ids=(), holder_report=()):
         idx = self.register_remote_node(conn, resources, store_name,
-                                        node_ip, session_dir, transfer_addr)
+                                        node_ip, session_dir, transfer_addr,
+                                        prior_idx=prior_idx,
+                                        worker_ids=worker_ids,
+                                        holder_report=holder_report)
         conn.reply(rid, idx, self.session_name,
                    msg_type=P.REGISTER_NODE_REPLY)
         # Handshake clock-offset probe: sample (agent_mono - head_mono)
@@ -632,12 +857,33 @@ class Head:
             node.store.close()
         if node.agent_conn is not None:
             node.agent_conn.on_close = None
+            try:
+                # deliberate eviction: tell the agent to die now rather
+                # than reconnect-and-re-register off the socket close
+                node.agent_conn.send(P.SHUTDOWN_NODE)
+            except P.ConnectionLost:
+                pass  # agent already gone (the usual removal cause)
             node.agent_conn.close()
         self._publish("node_removed", dumps(idx))
 
     def _kill_worker_process(self, w: WorkerInfo):
         w.state = "dead"
         if w.conn:
+            if w.sched_class is not None or w.actor_id is not None:
+                # r12: workers hold RECONNECTING head channels — a bare
+                # close reads as a head outage and the worker would
+                # linger for head_reconnect_timeout_s re-dialing the
+                # live head and retrying registration. Send the
+                # explicit die-now frame first (the context's
+                # KILL_ACTOR handler os._exit(0)s) so deliberate kills
+                # stay instant even when no agent/proc handle can
+                # deliver a signal (e.g. node removal after its agent
+                # died). Never sent to drivers (sched_class None,
+                # no actor).
+                try:
+                    w.conn.send(P.KILL_ACTOR, b"", True)
+                except P.ConnectionLost:
+                    pass
             w.conn.close()
         if w.proc and w.proc.poll() is None:
             try:
@@ -681,16 +927,41 @@ class Head:
             if rid > 0:
                 conn.reply_error(rid, ValueError(f"unknown msg {mt}"))
             return
+        # Request dedupe (GCS-FT analog): a reconnecting channel replays
+        # in-flight requests after reattach with their original rids. A
+        # mutation that already landed is re-ACKED from the cache (or
+        # the generic per-type ack for WAL-restored keys), never
+        # re-applied; first-time requests run under a recording proxy.
+        target = conn
+        if rid > 0 and mt in _DEDUPE_TYPES:
+            cid = getattr(conn, "client_id", None)
+            if cid is not None:
+                key = (cid, rid)
+                hit, cached = self._dedupe_lookup(key)
+                if hit:
+                    self.dedupe_hits += 1
+                    if cached is None:
+                        cached = _DEDUPE_GENERIC[mt]
+                    reply_mt, fields = cached
+                    try:
+                        conn.send(reply_mt, *fields, request_id=-rid)
+                    except P.ConnectionLost:
+                        pass
+                    return
+                target = _DedupeRecorder(self, conn, key, mt)
         try:
-            handler(self, conn, rid, *msg[2:])
+            handler(self, target, rid, *msg[2:])
         except P.ConnectionLost as e:
             # Swallow ONLY "the requester itself vanished mid-request"
             # (e.g. a worker killed during a shutdown wave): nobody to
             # answer, and replying would raise on the same dead socket.
-            # A ConnectionLost from some OTHER peer's socket inside a
-            # handler's fan-out is a real handler failure — surface it
-            # to the requester like any other exception.
-            if e.conn is not None and e.conn is not conn:
+            # Anything else — another peer's socket breaking inside a
+            # handler's fan-out, or a ConnectionLost UNPICKLED from a
+            # remote error reply (``__reduce__`` strips ``conn``, so it
+            # arrives with conn=None) — is a real handler failure: the
+            # requester is alive and must hear it, not block to its RPC
+            # timeout.
+            if e.conn is not conn:
                 if rid > 0:
                     try:
                         conn.reply_error(rid, e)
@@ -711,9 +982,49 @@ class Head:
 
                 traceback.print_exc()
 
+    def _dedupe_lookup(self, key):
+        """-> (hit, cached_reply_or_None)."""
+        with self._dedupe_lock:
+            if key in self._dedupe:
+                return True, self._dedupe[key]
+        return False, None
+
+    def _record_dedupe(self, key, mt: int, reply: tuple):
+        with self._dedupe_lock:
+            self._dedupe[key] = reply
+            while len(self._dedupe) > _DEDUPE_CAP:
+                self._dedupe.popitem(last=False)
+        if mt in _DEDUPE_DURABLE and self._persist is not None:
+            # the dedupe key must survive a crash ALONGSIDE the durable
+            # mutation it acks — a retry crossing a restart is then
+            # re-acked generically instead of re-applied
+            self._enqueue_wal(("dedupe", key[0], key[1]))
+
+    def _h_client_hello(self, conn, rid, client_id, reattach=False):
+        """A reconnecting head channel identifies itself (first frame on
+        every connect). The id keys the request-dedupe map; reattaches
+        are counted for the reconnect-storm doctor warning — alongside
+        the DISTINCT reattaching clients, so one clean restart of a
+        large cluster (one reattach per client) is distinguishable from
+        a flapping head (many reattaches per client)."""
+        conn.client_id = client_id
+        if reattach:
+            self.client_reconnects += 1
+            if len(self._reconnect_clients) < 8192:
+                self._reconnect_clients.add(client_id)
+
     # ----------------------------------------------------- worker registry
 
-    def _h_register(self, conn, rid, worker_id, pid, listen_addr, node_idx):
+    def _h_register(self, conn, rid, worker_id, pid, listen_addr, node_idx,
+                    actor_spec_bytes=None):
+        """Worker/driver registration. ``actor_spec_bytes`` (GCS-FT
+        re-registration): a surviving ACTOR worker reconnecting after a
+        head restart ships its creation TaskSpec so the restarted head
+        rebuilds the actor table from worker truth — the actor keeps its
+        state and address instead of being rescheduled from the WAL (the
+        reference's gcs_actor_manager rebuilding from reports after
+        failover)."""
+        reclaim_info = None
         with self._lock:
             node = self.nodes.get(node_idx)
             if node is None:
@@ -727,7 +1038,25 @@ class Head:
             w.listen_addr = listen_addr
             w.conn = conn
             conn.peer = f"worker:{worker_id[:8]}"
-            if w.state == "starting":
+            if self._grace_until:
+                # worker re-registrations extend the quiet window the
+                # early scheduling lift waits on — they trail their
+                # node's burst by a backoff round or two
+                self._last_node_reg_ts = time.monotonic()
+            stale_duplicate = False
+            if actor_spec_bytes is not None:
+                reclaim_info = self._reclaim_actor_locked(
+                    node, w, actor_spec_bytes)
+                if reclaim_info is None:
+                    # the actor was already rescheduled onto another
+                    # live worker while this one was away: this
+                    # surviving instance is a stale duplicate — it must
+                    # die, not linger as a second copy of the actor's
+                    # state (and not sit in "starting" feeding the
+                    # stuck-re-registering doctor warning)
+                    stale_duplicate = True
+                    w.actor_id = None
+            elif w.state == "starting":
                 w.state = "idle"
                 w.idle_since = time.monotonic()
                 if w.sched_class is not None:
@@ -735,7 +1064,87 @@ class Head:
                         worker_id)
         conn.reply(rid, node.store_name,
                    node.session_dir or self.session_dir)
+        if stale_duplicate:
+            with self._lock:
+                # ensure the die-now poison is sent (it is gated off
+                # drivers by sched_class/actor_id)
+                w.sched_class = w.sched_class or self.REATTACH_CLASS
+                self._kill_worker_process(w)
+                node.workers.pop(worker_id, None)
+            return
+        if reclaim_info is not None:
+            info, waiters = reclaim_info
+            self.emit_event(
+                "INFO", "head", "actor_reclaimed",
+                f"actor {info.actor_id.hex()[:8]}"
+                + (f" '{info.name}'" if info.name else "")
+                + f" re-claimed by surviving worker {worker_id[:8]}",
+                node_idx=node_idx, entity_id=info.actor_id.hex())
+            for wconn, wrid in waiters:
+                try:
+                    wconn.reply(wrid, "ALIVE", info.listen_addr,
+                                msg_type=P.GET_ACTOR_REPLY)
+                except P.ConnectionLost:
+                    pass
+            self._publish(f"actor:{info.actor_id.hex()}",
+                          dumps(("ALIVE", info.listen_addr)))
         self._try_fulfill_pending()
+
+    def _reclaim_actor_locked(self, node: NodeState, w: WorkerInfo,
+                              actor_spec_bytes: bytes):
+        """Rebuild an actor's table entry from its surviving worker's
+        re-registration (caller holds the head lock). Returns
+        (ActorInfo, pending_get waiters) or None when another live
+        worker already owns the actor id."""
+        spec: TaskSpec = loads(actor_spec_bytes)
+        aid = spec.actor_id
+        info = self.actors.get(aid)
+        if info is not None and info.state == "ALIVE" and \
+                info.worker_id and info.worker_id != w.worker_id:
+            # the current owner may live on ANY node (e.g. the WAL
+            # reschedule picked a different host after this worker's
+            # reattach outlasted the grace window) — checking only this
+            # node's table would let the stale instance steal back
+            for n in self.nodes.values():
+                other = n.workers.get(info.worker_id)
+                if other is not None and other.state != "dead":
+                    return None  # already rescheduled onto a live worker
+        w.state = "actor"
+        w.actor_id = aid
+        w.sched_class = spec.scheduling_class()
+        if info is None:
+            info = ActorInfo(actor_id=aid, spec=spec,
+                             name=spec.name or "")
+            self.actors[aid] = info
+        info.state = "ALIVE"
+        info.listen_addr = w.listen_addr
+        info.worker_id = w.worker_id
+        if info.name:
+            self.named_actors[info.name] = aid
+        waiters = list(info.pending_get_replies)
+        info.pending_get_replies.clear()
+        # the WAL-restored spec (if any) must not ALSO be rescheduled
+        # when the grace window lifts — the reclaim wins
+        aid_bin = aid.binary()
+        self._restored_actor_specs = [
+            sb for sb in self._restored_actor_specs
+            if loads(sb).actor_id.binary() != aid_bin]
+        # re-anchor resource accounting: the old lease died with the old
+        # head — mint a fresh one so the actor's resources are held and
+        # released on death like any scheduled actor's (best-effort: an
+        # oversubscribed post-restart node just skips the allocation)
+        req = ResourceSet(spec.resources)
+        if w.lease_id is None:
+            if node.resources.is_available(req):
+                node.resources.allocate(req)
+            else:
+                req = ResourceSet({})
+            lease_id = f"{self._lease_prefix}{next(self._lease_seq):x}"
+            self.leases[lease_id] = (node.idx, req, w.worker_id, None,
+                                     None)
+            w.lease_id = lease_id
+        self.actor_reclaims += 1
+        return info, waiters
 
     def register_driver(self, conn: Optional[P.Connection] = None):
         self._driver_conn = conn
@@ -892,6 +1301,12 @@ class Head:
         Callers hold the head lock (the RLock re-entry below costs a
         counter bump and keeps direct callers safe)."""
         cfg = get_config()
+        if self._grace_active():
+            # restarted head, re-registrations still streaming in:
+            # granting now would schedule against a half-empty node
+            # table — requests stay queued; the dispatcher's 0.25s tick
+            # retries until the window lifts
+            return None
         with self._lock:
             loc_choice = None
             pg_id = strategy.placement_group_id
@@ -2486,6 +2901,11 @@ class Head:
         ``slow_node`` (>= 30s apart per node+phase)."""
         from . import events as E
 
+        if self._grace_active():
+            # a restarted head's timelines/histograms are rebuilding —
+            # flagging against half-folded distributions would alarm on
+            # every re-registered task
+            return
         cfg = get_config()
         now = time.monotonic()
         flagged: List[tuple] = []
@@ -2759,6 +3179,33 @@ class Head:
                             "ring buffer",
              "tags": {}, "boundaries": None,
              "value": float(self.cluster_events_dropped)},
+            {"name": "head.reconnects",
+             "kind": "counter",
+             "description": "Head-channel reattachments "
+                            "(CLIENT_HELLO with reattach=true) from "
+                            "agents/drivers/workers",
+             "tags": {}, "boundaries": None,
+             "value": float(self.client_reconnects)},
+            {"name": "head.node_reattaches",
+             "kind": "counter",
+             "description": "Node agents that re-registered with a "
+                            "prior node id after a head restart or "
+                            "socket loss",
+             "tags": {}, "boundaries": None,
+             "value": float(self.node_reattaches)},
+            {"name": "head.actor_reclaims",
+             "kind": "counter",
+             "description": "Actors re-claimed by surviving workers "
+                            "after a head restart",
+             "tags": {}, "boundaries": None,
+             "value": float(self.actor_reclaims)},
+            {"name": "head.request_dedupe_hits",
+             "kind": "counter",
+             "description": "Retried mutations answered from the "
+                            "(client, request-id) dedupe cache "
+                            "instead of re-applied",
+             "tags": {}, "boundaries": None,
+             "value": float(self.dedupe_hits)},
         ]
 
     def _sq_io_loop(self, limit):
@@ -2767,6 +3214,17 @@ class Head:
         # timing surfaced through the debug state endpoints) +
         # ring-buffer drop counters: overflow of the bounded
         # event buffers must be detectable, not silent
+        now = time.monotonic()
+        with self._lock:
+            # workers recreated from agent re-registration reports
+            # that have not re-REGISTERed themselves yet — nonzero
+            # long after a restart means a node is stuck
+            # re-registering (doctor_warnings flags it)
+            pending = [now - w.spawned_at
+                       for n in self.nodes.values()
+                       for w in n.workers.values()
+                       if w.state == "starting"
+                       and w.sched_class == self.REATTACH_CLASS]
         return [dict(loop=self.io.name, **self.io.stats(),
                      **self.io.lag_stats(),
                      task_events_dropped=self.task_events_dropped,
@@ -2778,6 +3236,18 @@ class Head:
                      fold_queue_drops=self.fold_queue_drops,
                      lease_grant_batches=self.lease_grant_batches,
                      lease_grants_batched=self.lease_grants_batched,
+                     # head fault tolerance (r12): channel reattaches,
+                     # node/actor re-registrations, retried-mutation
+                     # dedupe hits, grace-window state
+                     client_reconnects=self.client_reconnects,
+                     reconnect_clients=len(self._reconnect_clients),
+                     node_reattaches=self.node_reattaches,
+                     actor_reclaims=self.actor_reclaims,
+                     dedupe_hits=self.dedupe_hits,
+                     restart_grace_active=bool(self._grace_until),
+                     reattach_pending_workers=len(pending),
+                     reattach_oldest_s=round(max(pending, default=0.0),
+                                             3),
                      # this process's data/return-plane fast-path
                      # counters (vectored sends, coalesced
                      # flushes, batched completions, zero-copy
@@ -3058,6 +3528,7 @@ class Head:
         P.RECOVER_OBJECT: lambda self, conn, rid, oid, owner:
             self._forward_to_worker(owner, P.RECOVER_OBJECT, oid),
         P.REGISTER_NODE: _h_register_node,
+        P.CLIENT_HELLO: _h_client_hello,
         P.TASK_EVENTS: _h_task_events,
         P.CLUSTER_EVENT: _h_cluster_events,
         P.STATE_QUERY: _h_state_query,
@@ -3164,6 +3635,11 @@ class Head:
         self._health_check()
         self._retry_pending_pgs()
         self._try_fulfill_pending()
+        # restored actors/PGs held back by the restart grace window are
+        # rescheduled here once it lifts (no-op on fresh sessions and
+        # after the first post-grace flush)
+        if self._restored_actor_specs or self._restored_pg_specs:
+            self._flush_restored()
         # Loop-lag sampling: a timestamped self-wakeup measures how long
         # a newly-arrived event waits for the IO thread (the reference's
         # instrumented_io_context event-stats role). Sampled every
@@ -3268,6 +3744,13 @@ class Head:
                     n.store.close()
                 if n.agent_conn is not None:
                     n.agent_conn.on_close = None
+                    try:
+                        # cluster shutdown is deliberate: agents exit
+                        # now instead of re-dialing the dead head for
+                        # the whole reconnect window
+                        n.agent_conn.send(P.SHUTDOWN_NODE)
+                    except P.ConnectionLost:
+                        pass
                     n.agent_conn.close()
             except Exception:
                 pass

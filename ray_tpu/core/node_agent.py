@@ -74,6 +74,7 @@ class NodeAgent:
         nr = detect_node_resources(num_cpus=num_cpus, num_tpus=num_tpus,
                                    object_store_memory=cap,
                                    resources=resources, labels=labels)
+        self._node_resources = nr  # re-sent on re-registration
         self.io = P.IOLoop("agent-io")
         # Direct peer-to-peer object plane (object_transfer.py): this host
         # serves its arena to peers and pulls from theirs — payloads never
@@ -84,8 +85,16 @@ class NodeAgent:
             self.io, self._read_object, advertise_ip=self.node_ip,
             partial_fn=self.store.partial)
         self.puller = ObjectPuller(self.io, self.store)
-        sock = P.connect_addr(head_addr)
-        self.head = P.Connection(sock, peer="head")
+        # Reconnecting head channel (GCS-FT analog: the raylet's GCS RPC
+        # client retrying across a gcs_server restart): on socket loss
+        # the agent re-dials up to head_reconnect_timeout_s, then
+        # re-registers with its prior node id, live worker set, and a
+        # full holder report so a restarted head rebuilds its node table
+        # and object directory from this host's truth. on_close fires
+        # only when the window expires — the pre-r12 fail-fast shutdown.
+        self.head = P.ReconnectingConnection(
+            head_addr, client_id=f"agent:{self.store_name}", peer="head",
+            on_reattach=self._on_head_reattach)
         self.head.on_close = lambda c: self._shutdown.set()
         self.io.add_connection(self.head, self._on_head_message)
         self.io.start()
@@ -196,6 +205,11 @@ class NodeAgent:
             elif mt == P.AGENT_OBJ_FREE:
                 for ob in msg[2]:
                     self.store.delete(ObjectID(ob))
+            elif mt == P.SHUTDOWN_NODE:
+                # deliberate eviction/cluster shutdown: die now — do
+                # NOT ride the reconnect window (that is for head
+                # CRASHES, where re-registration brings us back)
+                self._shutdown.set()
             elif mt == P.PING:
                 # health probe doubles as the clock-offset sampler: the
                 # head takes the RTT midpoint of this call against our
@@ -239,6 +253,37 @@ class NodeAgent:
         if self.node_idx is None or self._shutdown.is_set():
             return
         send_eviction_report_async(self.head, self.node_idx, oids)
+
+    def _on_head_reattach(self, conn):
+        """Reconnector-thread hook: the head channel came back (possibly
+        to a RESTARTED head with empty tables) — re-register carrying
+        our prior node id, the live worker set, and a holder report of
+        every sealed object in this host's arena, so the head rebuilds
+        its node table and object directory from holder truth
+        (reference: raylet re-registration within
+        gcs_rpc_server_reconnect_timeout_s)."""
+        if self._shutdown.is_set():
+            return
+        with self._lock:
+            prior = self.node_idx if self.node_idx is not None else -1
+            wids = [wid for wid, p in self.workers.items()
+                    if p.poll() is None]
+        # full report: the native table holds at most 65536 entries, so
+        # this cap is exhaustive; a report that FILLS it still warns —
+        # a silent truncation would read as "directory rebuilt" while
+        # pre-crash objects quietly went missing
+        listed = self.store.list_objects(max_objects=65536)
+        if len(listed) >= 65536:
+            print("[ray_tpu] holder report hit the 65536-entry cap; "
+                  "directory rebuild may be incomplete", flush=True)
+        holders = [(oid.binary(), size) for oid, size in listed]
+        reply = conn.call(P.REGISTER_NODE, self._node_resources,
+                          self.store_name, self.node_ip, self.session_dir,
+                          self.transfer_server.addr, prior, wids, holders,
+                          timeout=30)
+        with self._lock:
+            self.node_idx = reply[0]
+        self.session_name = reply[1]
 
     # ------------------------------------------------------------- workers
 

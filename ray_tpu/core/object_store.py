@@ -67,6 +67,10 @@ def _load_lib():
     lib.shm_store_capacity.argtypes = [ctypes.c_void_p]
     lib.shm_store_num_objects.restype = ctypes.c_uint64
     lib.shm_store_num_objects.argtypes = [ctypes.c_void_p]
+    lib.shm_store_list.restype = ctypes.c_int
+    lib.shm_store_list.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int]
     return lib
 
 
@@ -246,7 +250,12 @@ class ShmObjectStore:
                          name=f"shm-populate-{name}",
                          daemon=True).start()
 
-    _POPULATE_CHUNK = 64 << 20
+    # Sub-chunk per lock hold: a single madvise of 64 MiB can take ~1s+
+    # on the deployment kernel as populated segments accumulate, and
+    # close() (node teardown) blocks on this lock — 4 MiB holds keep the
+    # worst-case stall in the low milliseconds while costing only ~16x
+    # more (cheap) lock round-trips per arena.
+    _POPULATE_CHUNK = 4 << 20
 
     def _populate_bg(self):
         # On kernels without MADV_POPULATE_WRITE this returns immediately
@@ -374,6 +383,20 @@ class ShmObjectStore:
         if self._closed:
             return 0
         return get_lib().shm_store_num_objects(self._h)
+
+    def list_objects(self, max_objects: int = 8192
+                     ) -> List[Tuple[ObjectID, int]]:
+        """Sealed objects currently in the arena as ``[(ObjectID,
+        data+meta bytes)]`` — the holder report a re-registering node
+        agent ships so a restarted head can rebuild its object directory
+        from holder truth (the directory is deliberately not WAL'd)."""
+        if self._closed:
+            return []
+        ids = ctypes.create_string_buffer(_ID_SIZE * max_objects)
+        sizes = (ctypes.c_uint64 * max_objects)()
+        n = get_lib().shm_store_list(self._h, ids, sizes, max_objects)
+        return [(ObjectID(ids.raw[i * _ID_SIZE:(i + 1) * _ID_SIZE]),
+                 int(sizes[i])) for i in range(n)]
 
     # -- in-progress pull availability (cooperative broadcast) ---------------
 

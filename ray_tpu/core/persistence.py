@@ -16,6 +16,11 @@ worker state — is rebuilt by the live cluster re-registering):
 - ``("actor_gone", actor_id_bin)`` named actor permanently dead/killed
 - ``("pg", spec_bytes)``           placement group created
 - ``("pg_gone", pg_id_bin)``       placement group removed
+- ``("dedupe", client_id, rid)``   a client request that produced one of
+                                   the durable mutations above was
+                                   applied — a restarted head re-acks a
+                                   retried copy instead of applying it
+                                   twice (GCS-FT request dedupe)
 - ``("snapshot", state_dict)``     compaction record (always first after
                                    a compaction; replay starts from it)
 """
@@ -130,11 +135,13 @@ def replay(records: List[tuple]) -> Dict[str, Any]:
     """Fold the WAL into the durable-state dict.
 
     Returns ``{"kv": {ns: {key: value}}, "actors": {actor_id_bin:
-    spec_bytes}, "pgs": {pg_id_bin: spec_bytes}}``.
+    spec_bytes}, "pgs": {pg_id_bin: spec_bytes}, "dedupe":
+    [(client_id, rid), ...]}``.
     """
     kv: Dict[Any, Dict[Any, Any]] = {}
     actors: Dict[bytes, bytes] = {}
     pgs: Dict[bytes, bytes] = {}
+    dedupe: Dict[Tuple[str, int], None] = {}  # insertion-ordered set
     for rec in records:
         kind = rec[0]
         if kind == "snapshot":
@@ -142,6 +149,10 @@ def replay(records: List[tuple]) -> Dict[str, Any]:
             kv = {ns: dict(t) for ns, t in state.get("kv", {}).items()}
             actors = dict(state.get("actors", {}))
             pgs = dict(state.get("pgs", {}))
+            dedupe = dict.fromkeys(
+                tuple(k) for k in state.get("dedupe", ()))
+        elif kind == "dedupe":
+            dedupe[(rec[1], rec[2])] = None
         elif kind == "kv_put":
             _, ns, key, value = rec
             kv.setdefault(ns, {})[key] = value
@@ -158,7 +169,10 @@ def replay(records: List[tuple]) -> Dict[str, Any]:
             pgs[_pg_key(spec_bytes)] = spec_bytes
         elif kind == "pg_gone":
             pgs.pop(rec[1], None)
-    return {"kv": kv, "actors": actors, "pgs": pgs}
+    # bound what a snapshot / restore carries: only recent request ids
+    # matter (a client retries within head_reconnect_timeout_s)
+    keys = list(dedupe)[-4096:]
+    return {"kv": kv, "actors": actors, "pgs": pgs, "dedupe": keys}
 
 
 def _actor_key(spec_bytes: bytes) -> bytes:

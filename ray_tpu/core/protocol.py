@@ -157,6 +157,22 @@ LEASE_GRANT_BATCH = 73  # head->driver, one-way: ([(rid, worker_id,
                         # ONE frame (one pickle, one syscall) instead of
                         # a LEASE_REPLY per lease; the driver completes
                         # each rid's blocked call from the batch
+SHUTDOWN_NODE = 75      # head->agent, one-way: () — the head is
+#                         DELIBERATELY cutting this node loose (cluster
+#                         shutdown, eviction): the agent must exit
+#                         instead of treating the coming socket close as
+#                         a head outage and re-dialing for the whole
+#                         reconnect window (reference: an evicted raylet
+#                         kills itself on learning of its eviction)
+CLIENT_HELLO = 74       # client->head, one-way: (client_id, reattach) —
+#                         sent first on every (re)connect of a
+#                         reconnecting head channel. The head stamps the
+#                         connection with the client's stable id so
+#                         retried mutations can be deduped by
+#                         (client_id, request_id), and counts reattaches
+#                         (reattach=True on every connect after the
+#                         first — the GCS-FT analog of a raylet
+#                         re-establishing its GCS RPC channel)
 OBJ_PULL_FAIL = 72      # server->puller: (oid_bin, offset) — the server
                         # cannot complete the requested range past
                         # `offset` (its own in-progress pull aborted, or
@@ -273,6 +289,7 @@ class Connection:
         self.closed = False
         self.on_close: Optional[Callable[["Connection"], None]] = None
         self._ioloop: Optional["IOLoop"] = None
+        self._on_message_cb = None  # set by IOLoop.add_connection
         sock.setblocking(True)
 
     # -- send side --
@@ -609,6 +626,13 @@ class Connection:
         w.event.set()
         return True
 
+    def _io_eof(self, sock=None):
+        """IO loop saw EOF/error on this socket. Plain connections die;
+        a ReconnectingConnection overrides this to begin reattachment
+        instead of failing its waiters (``sock`` identifies WHICH socket
+        died, so a stale EOF from a replaced socket is ignored)."""
+        self.close()
+
     def close(self):
         if self.closed:
             return
@@ -641,6 +665,275 @@ class _Waiter:
         self.event = threading.Event()
         self.value = None
         self.error = None
+
+
+def backoff_delay(attempt: int, base: float = 0.05, cap: float = 2.0,
+                  rng=None) -> float:
+    """Reconnect backoff schedule: exponential from ``base`` capped at
+    ``cap``, with +/-50% jitter so a fleet of agents losing one head
+    does not reconnect in lockstep (the reference's
+    gcs_rpc_server_reconnect backoff role). ``rng`` is a 0..1 callable
+    (tests inject a deterministic one)."""
+    import random
+
+    d = min(cap, base * (2.0 ** attempt))
+    r = rng() if rng is not None else random.random()
+    return d * (0.5 + r)
+
+
+class ReconnectingConnection(Connection):
+    """A head channel that survives the head dying and coming back.
+
+    The GCS-FT client analog: the reference's raylets/workers keep their
+    GCS RPC channel alive across a gcs_server restart, retrying for
+    ``gcs_rpc_server_reconnect_timeout_s`` before giving up. Here the
+    Connection object is PERSISTENT — on socket loss only the socket
+    underneath is replaced, so every caller-held reference (and every
+    parked ``call()`` waiter in ``_pending``) survives the outage:
+
+    * writes during an outage park (block) until reattach, then retry;
+    * in-flight ``call()``s keep their waiters — after reattach their
+      requests are re-sent verbatim with the SAME request id, and the
+      head's (client_id, request_id) dedupe map keeps a retried
+      mutation that already landed from applying twice;
+    * ``on_reattach(conn)`` runs on the reconnector thread after the new
+      socket registers (and after CLIENT_HELLO), BEFORE parked senders
+      resume — the re-registration protocol (REGISTER_NODE with prior
+      node id + holder report, driver/worker REGISTER) runs there, so
+      nothing races ahead of it;
+    * past ``head_reconnect_timeout_s`` of failed attempts the channel
+      closes for real: parked senders and waiters get the ordinary
+      fail-fast ``ConnectionLost``, and ``on_close`` fires exactly once
+      (agents shut down, workers exit — the pre-reconnect semantics).
+    """
+
+    def __init__(self, addr: str, *, client_id: str, peer: str = "head",
+                 reconnect_timeout_s: Optional[float] = None,
+                 on_reattach: Optional[Callable[["Connection"], None]]
+                 = None):
+        sock = connect_addr(addr)
+        super().__init__(sock, peer=peer)
+        self.addr = addr
+        self.client_id = client_id
+        self.on_reattach = on_reattach
+        self._timeout_s = reconnect_timeout_s
+        self._attached = threading.Event()
+        self._attached.set()
+        self._final = False
+        self._reconnect_lock = threading.Lock()
+        self._reconnecting = False
+        self._reconnector: Optional[threading.Thread] = None
+        self._give_up_at: Optional[float] = None
+        # rid -> (msg_type, fields): requests whose reply is still
+        # pending, re-sent verbatim after a reattach
+        self._inflight_reqs: Dict[int, tuple] = {}
+        self._inflight_lock = threading.Lock()
+        self.reconnects = 0          # successful reattachments
+        self.reconnect_attempts = 0  # dial attempts (incl. failures)
+        # identify ourselves so the head can dedupe retried requests
+        self.send(CLIENT_HELLO, client_id, False)
+
+    def _reconnect_window_s(self) -> float:
+        if self._timeout_s is not None:
+            return self._timeout_s
+        from .config import get_config
+
+        return get_config().head_reconnect_timeout_s
+
+    # -- send/call overrides -------------------------------------------
+
+    def _wait_attached(self):
+        if self._final:
+            raise ConnectionLost(
+                f"{self.peer}: head unreachable past reconnect window",
+                conn=self)
+        if self._attached.is_set():
+            return
+        if threading.current_thread() is self._reconnector:
+            return  # re-registration traffic bypasses the gate
+        if self._ioloop is not None and \
+                threading.current_thread() is self._ioloop._thread:
+            # NEVER park the IO loop: it must stay live to deliver the
+            # re-registration replies the reattach handshake blocks on.
+            # Handlers sending on the head channel during an outage get
+            # the ordinary ConnectionLost (they all tolerate it).
+            raise ConnectionLost(f"{self.peer}: reconnecting", conn=self)
+        while not self._attached.wait(0.5):
+            if self._final:
+                break
+        if self._final:
+            raise ConnectionLost(
+                f"{self.peer}: head unreachable past reconnect window",
+                conn=self)
+
+    def _send_frames(self, bufs: tuple, nbytes: int):
+        while True:
+            self._wait_attached()
+            failed_sock = self.sock
+            try:
+                return super()._send_frames(bufs, nbytes)
+            except ConnectionLost:
+                if self._final or self.closed:
+                    raise
+                if threading.current_thread() is self._reconnector:
+                    # a reattach-handshake send failed (head died again
+                    # mid-handshake): surface to _reconnect_loop, which
+                    # discards the half-attached socket and retries with
+                    # backoff — retrying HERE would spin on the same
+                    # dead socket forever (_socket_dead no-ops while
+                    # _reconnecting is set)
+                    raise
+                # socket died under us: begin (or join) reattachment and
+                # retry the frame on the next socket — a partially-sent
+                # frame is harmless, the new head reads a fresh stream
+                self._socket_dead(failed_sock)
+
+    def call(self, msg_type: int, *fields,
+             timeout: Optional[float] = None):
+        """Like Connection.call, but the request is recorded so a
+        reattach can replay it (same rid — the head dedupes)."""
+        rid = next(self._req_counter)
+        w = _Waiter()
+        with self._pending_lock:
+            self._pending[rid] = w
+        with self._inflight_lock:
+            self._inflight_reqs[rid] = (msg_type, fields)
+        try:
+            self.send(msg_type, *fields, request_id=rid)
+            if not w.event.wait(timeout):
+                raise TimeoutError(
+                    f"RPC {msg_type} to {self.peer} timed out")
+            if w.error is not None:
+                raise w.error
+            return w.value
+        finally:
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            with self._inflight_lock:
+                self._inflight_reqs.pop(rid, None)
+
+    # -- detach / reattach ---------------------------------------------
+
+    def _io_eof(self, sock=None):
+        self._socket_dead(sock)
+
+    def _socket_dead(self, dead_sock=None):
+        """The given socket is gone. Start one reconnector; concurrent
+        callers (IO-loop EOF racing a failed send) just return — their
+        own retry loops block on ``_attached``. A STALE report about an
+        already-replaced socket (a late EOF event or a send failure that
+        lost the race with a completed reattach) must not kill the new
+        healthy socket."""
+        with self._reconnect_lock:
+            if self._final or self.closed or self._reconnecting:
+                return
+            if dead_sock is not None and dead_sock is not self.sock:
+                return  # stale report about a replaced socket
+            self._reconnecting = True
+            self._attached.clear()
+            if self._give_up_at is None:
+                self._give_up_at = (time.monotonic()
+                                    + self._reconnect_window_s())
+            if self._ioloop is not None:
+                self._ioloop.remove(self.sock)
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self._reconnector = threading.Thread(
+                target=self._reconnect_loop, daemon=True,
+                name=f"reconnect-{self.peer}")
+            self._reconnector.start()
+
+    def _reconnect_loop(self):
+        attempt = 0
+        while not self._final:
+            deadline = self._give_up_at
+            now = time.monotonic()
+            if deadline is not None and now >= deadline:
+                self._give_up()
+                return
+            self.reconnect_attempts += 1
+            try:
+                budget = 5.0 if deadline is None else \
+                    max(0.2, min(5.0, deadline - now))
+                sock = connect_addr(self.addr, timeout=budget)
+            except OSError:
+                time.sleep(backoff_delay(attempt))
+                attempt += 1
+                continue
+            try:
+                self._attach(sock)
+            except ConnectionLost:
+                # head answered then died again mid-handshake: next round
+                self._discard_half_attached(sock)
+                time.sleep(backoff_delay(attempt))
+                attempt += 1
+                continue
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+                self._discard_half_attached(sock)
+                time.sleep(backoff_delay(attempt))
+                attempt += 1
+                continue
+            return
+
+    def _discard_half_attached(self, sock: socket.socket):
+        """A reattach handshake failed after the socket may already have
+        been registered with the IO loop — unregister FIRST (a closed fd
+        left in the selector would make the loop spin on EBADF), then
+        close."""
+        if self._ioloop is not None:
+            self._ioloop.remove(sock)
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _attach(self, sock: socket.socket):
+        """Swap the new socket in, re-register with the IO loop, run the
+        re-registration hook, replay in-flight requests, release parked
+        senders. Runs on the reconnector thread."""
+        self.sock = sock
+        self._rbuf = bytearray()
+        if self._ioloop is not None and self._on_message_cb is not None:
+            self._ioloop.add_connection(self, self._on_message_cb)
+        else:
+            sock.setblocking(True)
+        self.send(CLIENT_HELLO, self.client_id, True)
+        if self.on_reattach is not None:
+            self.on_reattach(self)
+        # replay requests whose replies died with the old head — same
+        # rids, so the head's dedupe map absorbs true duplicates
+        with self._inflight_lock:
+            replay = sorted(self._inflight_reqs.items())
+        for rid, (mt, fields) in replay:
+            with self._pending_lock:
+                if rid not in self._pending:
+                    continue  # caller gave up while we were away
+            self.send(mt, *fields, request_id=rid)
+        self.reconnects += 1
+        with self._reconnect_lock:
+            self._reconnecting = False
+            self._give_up_at = None
+        self._attached.set()
+
+    def _give_up(self):
+        """Reconnect window expired: fail fast exactly like a plain
+        connection dying — waiters get ConnectionLost, on_close fires."""
+        with self._reconnect_lock:
+            self._final = True
+            self._reconnecting = False
+        self._attached.set()  # release parked senders into the raise
+        super().close()
+
+    def close(self):
+        """Deliberate, final close (shutdown paths)."""
+        self._final = True
+        self._attached.set()
+        super().close()
 
 
 class IOLoop:
@@ -697,6 +990,9 @@ class IOLoop:
                        on_message: Callable[[Connection, Tuple], None]):
         conn.sock.setblocking(False)
         conn._ioloop = self
+        # remembered so a ReconnectingConnection can re-register its
+        # replacement socket with the same handler after a reattach
+        conn._on_message_cb = on_message
         with self._lock:
             self.sel.register(conn.sock, 1, ("conn", on_message, conn))
         self._wake()
@@ -807,7 +1103,7 @@ class IOLoop:
             data = b""
         if not data:
             self.remove(sock)
-            conn.close()
+            conn._io_eof(sock)
             return
         for msg in conn.feed(data):
             if conn.dispatch_reply(msg):

@@ -201,6 +201,17 @@ DOCTOR_ENDPOINTS = (
 # this long for the loop): warn, pointing at the usual culprits.
 LOOP_LAG_WARN_MS = 250.0
 
+# Head-channel reattachments above this mean clients are reconnecting
+# over and over (a reconnect STORM): the head is flapping — crashing
+# repeatedly, or its socket is being cut by something between — rather
+# than having restarted once.
+RECONNECT_STORM_THRESHOLD = 20
+
+# A worker reported live by a re-registering agent that has not
+# re-REGISTERed itself within this long is stuck (wedged interpreter, or
+# its node's re-registration is looping): the node is not fully back.
+REATTACH_STUCK_S = 15.0
+
 
 def doctor_warnings() -> list:
     """Health warnings that are not endpoint failures: nonzero
@@ -247,6 +258,29 @@ def doctor_warnings() -> list:
                 f"(> {LOOP_LAG_WARN_MS:.0f}ms p99) — every control-plane "
                 "RPC queues behind it; look for slow handlers "
                 "(slow_events / max_handler_s in io_loop state)")
+        rc = row.get("client_reconnects", 0)
+        distinct = row.get("reconnect_clients", 0)
+        # a STORM is many reattaches PER CLIENT, not a big cluster
+        # riding out one clean restart (which costs exactly one
+        # reattach per client): require both an absolute floor and a
+        # >3x reattach-to-client ratio
+        if rc > max(RECONNECT_STORM_THRESHOLD, 3 * max(distinct, 1)):
+            warns.append(
+                f"client_reconnects={rc} across {distinct} clients: "
+                "reconnect storm — head channels are reattaching "
+                "repeatedly; the head is flapping or its socket path "
+                "is unstable (one clean restart costs one reattach "
+                "per client)")
+        stuck = row.get("reattach_pending_workers", 0)
+        oldest = row.get("reattach_oldest_s", 0.0)
+        if stuck and oldest > REATTACH_STUCK_S:
+            warns.append(
+                f"reattach_pending_workers={stuck} (oldest "
+                f"{oldest:.0f}s): a node is stuck re-registering — "
+                "workers its agent reported alive never re-REGISTERed "
+                "with the restarted head; they will be ghost-swept at "
+                "worker_register_timeout_s, check the node's worker "
+                "logs")
     return warns
 
 

@@ -413,6 +413,28 @@ int shm_store_evict(void* handle, uint64_t need, uint8_t* out_ids, int max_ids) 
   return count;
 }
 
+// List sealed objects: packs up to max_ids ids (20 bytes each) into
+// out_ids and their total sizes (data + metadata) into out_sizes;
+// returns the count. The holder-report path: a node agent re-registering
+// with a restarted head enumerates its arena so the head can rebuild the
+// object directory from holder truth (the directory is deliberately not
+// written to the head WAL).
+int shm_store_list(void* handle, uint8_t* out_ids, uint64_t* out_sizes,
+                   int max_ids) {
+  Store* s = static_cast<Store*>(handle);
+  Header* h = s->hdr;
+  Guard g(h);
+  int count = 0;
+  for (uint32_t i = 0; i < kTableSize && count < max_ids; i++) {
+    ObjectEntry* e = &h->table[i];
+    if (e->state != kSealed) continue;
+    memcpy(out_ids + count * kIdSize, e->id, kIdSize);
+    out_sizes[count] = e->data_size + e->meta_size;
+    count++;
+  }
+  return count;
+}
+
 uint64_t shm_store_bytes_in_use(void* handle) {
   Store* s = static_cast<Store*>(handle);
   Guard g(s->hdr);

@@ -243,6 +243,65 @@ def _ensure_venv(ctx, reqs: List[str]) -> str:
     return site
 
 
+def _overlay_top_level(site: str) -> List[str]:
+    """Top-level importable names a pip venv overlay provides: the
+    package dirs and modules pip installed into its site-packages
+    (``--system-site-packages`` venvs start empty, so everything present
+    was installed for THIS requirements digest), refined by each
+    dist-info's ``top_level.txt`` when present."""
+    names = set()
+    try:
+        entries = os.listdir(site)
+    except OSError:
+        return []
+    for entry in entries:
+        path = os.path.join(site, entry)
+        if entry.endswith(".dist-info"):
+            try:
+                with open(os.path.join(path, "top_level.txt")) as f:
+                    names.update(ln.strip() for ln in f if ln.strip())
+            except OSError:
+                pass
+        elif entry.endswith(".py") and not entry.startswith("_"):
+            names.add(entry[:-3])
+        elif os.path.isdir(path) and not entry.startswith("_") \
+                and "." not in entry:
+            names.add(entry)
+    return sorted(names)
+
+
+def _purge_shadowed_modules(site: str):
+    """Drop sys.modules entries for names the overlay provides whose
+    cached import came from OUTSIDE the overlay (the baked image): the
+    next import inside the task resolves through the overlay's
+    site-packages at the head of sys.path, so the requested version
+    actually loads."""
+    root = os.path.abspath(site) + os.sep
+    tops = set(_overlay_top_level(site))
+    if not tops:
+        return
+    purged = []
+    for name, mod in list(sys.modules.items()):
+        if name.split(".", 1)[0] not in tops:
+            continue
+        f = getattr(mod, "__file__", None)
+        under = bool(f and os.path.abspath(f).startswith(root))
+        if not under:
+            for p in list(getattr(mod, "__path__", None) or []):
+                if os.path.abspath(p).startswith(root):
+                    under = True
+                    break
+        if not under:
+            del sys.modules[name]
+            purged.append(name)
+    if purged:
+        roots = sorted({n.split('.', 1)[0] for n in purged})
+        print(f"[ray_tpu] runtime_env pip overlay: purged "
+              f"{len(purged)} cached baked-image modules shadowing the "
+              f"requested versions ({', '.join(roots[:5])}"
+              f"{'...' if len(roots) > 5 else ''})", file=sys.stderr)
+
+
 class applied:
     """Context manager applying a runtime_env around one task execution
     (the reference applies per worker-process; our workers are pooled
@@ -281,6 +340,15 @@ class applied:
                 site = _ensure_venv(self._ctx, reqs)
                 sys.path.insert(0, site)
                 self._added_paths.append(site)
+                # Evict already-imported BAKED modules the overlay
+                # provides: workers are pooled, so an earlier task may
+                # have imported package X from the image — without this
+                # a task requesting pip=['X==2.0'] silently keeps
+                # running the cached baked version (sys.path order only
+                # decides FUTURE imports). The __exit__ purge below then
+                # removes the overlay-origin modules, so the next task
+                # re-imports the baked ones cleanly.
+                _purge_shadowed_modules(site)
         except BaseException:
             self.__exit__(*sys.exc_info())
             raise

@@ -147,15 +147,25 @@ def test_put_objects_are_not_reconstructable(ray_start_cluster):
 
 
 class _FakeConn:
+    peer = "fake"
+
     def __init__(self):
         self.replies = []
         self.errors = []
+        self.sent = []
+        self.closed = False
 
     def reply(self, rid, *fields, msg_type=None):
         self.replies.append(fields)
 
     def reply_error(self, rid, err):
         self.errors.append(err)
+
+    def send(self, mt, *fields, request_id=0):
+        self.sent.append((mt, request_id, fields))
+
+    def close(self):
+        self.closed = True
 
 
 def test_head_wal_restores_kv_and_named_actors(tmp_path):
@@ -303,3 +313,425 @@ def test_failed_reconstruction_fails_borrower_promptly(ray_start_cluster,
     assert not isinstance(excinfo.value, GetTimeoutError), (
         "borrower fell back to its get timeout instead of being failed "
         "promptly by SEAL_ABORTED")
+
+
+# ================================================= head fault tolerance
+#
+# r12 (GCS-FT analog): the live cluster survives a head crash + restart.
+# Unit tests cover the reconnect backoff schedule, the head's
+# (client_id, request_id) mutation dedupe, and the restart grace
+# window's lease holdback; the chaos tests kill -9 a real head process
+# under a live multi-process cluster (reference:
+# python/ray/tests/test_gcs_fault_tolerance.py).
+
+
+def test_reconnect_backoff_schedule():
+    from ray_tpu.core.protocol import backoff_delay
+
+    # deterministic mid-jitter: rng() = 0.5 -> multiplier exactly 1.0
+    mid = [backoff_delay(a, base=0.05, cap=2.0, rng=lambda: 0.5)
+           for a in range(10)]
+    # exponential doubling from base...
+    assert mid[0] == pytest.approx(0.05)
+    assert mid[1] == pytest.approx(0.10)
+    assert mid[2] == pytest.approx(0.20)
+    # ...capped (a fleet must not back off into oblivion)
+    assert mid[-1] == pytest.approx(2.0)
+    assert all(b >= a for a, b in zip(mid, mid[1:]))
+    # jitter spans [0.5x, 1.5x): lockstep reconnect stampedes decorrelate
+    lo = backoff_delay(3, rng=lambda: 0.0)
+    hi = backoff_delay(3, rng=lambda: 0.999)
+    assert lo == pytest.approx(0.5 * mid[3])
+    assert hi < 1.5 * mid[3]
+
+
+def test_request_id_dedupe_mutations(tmp_path):
+    """A mutation replayed with the same (client_id, rid) after a
+    reattach is re-ACKED from the cache, not re-applied — the first
+    reply's exact content comes back."""
+    from ray_tpu.core import protocol as P
+    from ray_tpu.core.head import Head
+
+    h = Head(str(tmp_path), "dd1")
+    try:
+        conn = _FakeConn()
+        conn.sent = []
+
+        def send(mt, *fields, request_id=0):
+            conn.sent.append((mt, request_id, fields))
+
+        conn.send = send
+        h._on_message(conn, (P.CLIENT_HELLO, 0, "cli-1", False))
+        assert conn.client_id == "cli-1"
+        # first KV_PUT(overwrite=False) applies and replies added=True
+        h._on_message(conn, (P.KV_PUT, 7, "ns", "k", b"v1", False))
+        assert h.kv["ns"]["k"] == b"v1"
+        assert conn.replies[-1] == (True,)
+        # the replayed copy: re-acked True from the cache — a re-apply
+        # would reply added=False (key exists) and is the bug
+        h._on_message(conn, (P.KV_PUT, 7, "ns", "k", b"v1", False))
+        assert h.dedupe_hits == 1
+        assert h.kv["ns"]["k"] == b"v1"
+        replayed = conn.sent[-1]
+        assert replayed[0] == P.OK and replayed[1] == -7 \
+            and replayed[2] == (True,)
+        # a DIFFERENT rid from the same client is a genuine new request
+        h._on_message(conn, (P.KV_PUT, 8, "ns", "k", b"v2", False))
+        assert h.dedupe_hits == 1
+        assert conn.replies[-1] == (False,)  # overwrite=False honored
+        # connections that never sent CLIENT_HELLO (old clients / unit
+        # fakes) bypass dedupe entirely
+        anon = _FakeConn()
+        h._on_message(anon, (P.KV_PUT, 7, "ns", "k2", b"x", False))
+        h._on_message(anon, (P.KV_PUT, 7, "ns", "k2", b"x", False))
+        assert h.dedupe_hits == 1
+    finally:
+        h.shutdown()
+
+
+def test_request_dedupe_survives_head_restart(tmp_path):
+    """Dedupe keys of WAL-durable mutations persist: a retry that
+    crosses a head CRASH is re-acked generically instead of re-applied
+    (a re-applied CREATE_ACTOR would fail 'name taken')."""
+    from ray_tpu.core import protocol as P
+    from ray_tpu.core.head import Head
+
+    h1 = Head(str(tmp_path), "dd2")
+    conn = _FakeConn()
+    h1._on_message(conn, (P.CLIENT_HELLO, 0, "cli-9", False))
+    h1._on_message(conn, (P.KV_PUT, 41, "app", "cfg", b"v", False))
+    h1._drain_wal_backlog()
+    h1.shutdown()
+
+    h2 = Head(str(tmp_path), "dd3")
+    try:
+        assert h2.kv["app"]["cfg"] == b"v"  # WAL restored
+        conn2 = _FakeConn()
+        conn2.sent = []
+        conn2.send = lambda mt, *f, request_id=0: conn2.sent.append(
+            (mt, request_id, f))
+        h2._on_message(conn2, (P.CLIENT_HELLO, 0, "cli-9", True))
+        assert h2.client_reconnects == 1
+        # the replayed pre-crash request: generic success ack, value kept
+        h2._on_message(conn2, (P.KV_PUT, 41, "app", "cfg", b"v", False))
+        assert h2.dedupe_hits == 1
+        assert conn2.sent[-1] == (P.OK, -41, (True,))
+        assert h2.kv["app"]["cfg"] == b"v"
+    finally:
+        h2.shutdown()
+
+
+def test_node_reattach_rebuilds_directory(tmp_path):
+    """REGISTER_NODE with a prior node id recreates the node under the
+    SAME index, recreates reported workers as leasable-once-registered
+    entries, and rebuilds the object directory from the holder report
+    (the directory is deliberately not WAL'd)."""
+    from ray_tpu.core import protocol as P
+    from ray_tpu.core.head import Head
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.resources import detect_node_resources
+
+    h = Head(str(tmp_path), "ra1")
+    try:
+        conn = _FakeConn()
+        oid = ObjectID.from_random()
+        nr = detect_node_resources(num_cpus=2, num_tpus=0)
+        h._h_register_node(
+            conn, 1, nr, "store_x", "10.0.0.9", "/tmp/sess_x",
+            "tcp:10.0.0.9:7", 5, ["w_a", "w_b"],
+            [(oid.binary(), 4096)])
+        assert conn.replies[-1][0] == 5  # prior index preserved
+        assert 5 in h.nodes and h._next_node_idx >= 6
+        node = h.nodes[5]
+        assert set(node.workers) == {"w_a", "w_b"}
+        assert all(w.state == "starting"
+                   and w.sched_class == Head.REATTACH_CLASS
+                   for w in node.workers.values())
+        loc = h.objects.get(oid)
+        assert loc is not None and 5 in loc.holders and loc.size == 4096
+        assert h.node_reattaches == 1
+        types = [ev[5] for ev in h.cluster_events]
+        assert "node_reattached" in types
+        # a reattach-reported worker REGISTERing becomes a leasable
+        # idle worker under the reattach class
+        wconn = _FakeConn()
+        h._h_register(wconn, 2, "w_a", 1234, "unix:/w_a", 5)
+        assert node.workers["w_a"].state == "idle"
+        assert "w_a" in node.idle_by_class[Head.REATTACH_CLASS]
+    finally:
+        h.shutdown()
+
+
+def test_restart_grace_holds_leases(tmp_path):
+    """A RESTARTED head (WAL records found) holds lease granting while
+    re-registrations stream in; the window lifts once the node table is
+    quiet and queued leases then grant."""
+    import time
+
+    from ray_tpu.core import protocol as P
+    from ray_tpu.core.head import Head, WorkerInfo
+    from ray_tpu.core.serialization import dumps
+    from ray_tpu.core.task_spec import SchedulingStrategy
+
+    h1 = Head(str(tmp_path), "gr1")
+    h1._h_kv_put(_FakeConn(), 0, "ns", "k", b"v", True)
+    h1._drain_wal_backlog()
+    h1.shutdown()
+
+    h2 = Head(str(tmp_path), "gr2")
+    try:
+        assert h2._grace_until > 0  # restart detected
+        types = [ev[5] for ev in h2.cluster_events]
+        assert "head_restarted" in types
+        idx = h2.add_node(num_cpus=2, object_store_memory=8 << 20)
+        node = h2.nodes[idx]
+        cls = ("grace_cls",)
+        with h2._lock:
+            node.workers["gw"] = WorkerInfo(
+                worker_id="gw", node_idx=idx, listen_addr="unix:/gw",
+                state="idle", sched_class=cls)
+            node.idle_by_class.setdefault(cls, []).append("gw")
+        conn = _FakeConn()
+        conn.sent = []
+        conn.send = lambda mt, *f, request_id=0: conn.sent.append(
+            (mt, request_id, f))
+        h2._queue_lease(conn, 1, cls, {"CPU": 1}, "job",
+                        dumps(SchedulingStrategy()), None)
+        # registrations are still streaming (node registered just now):
+        # the pass grants NOTHING
+        h2._grace_until = time.monotonic() + 60.0
+        h2._last_node_reg_ts = time.monotonic()
+        h2._try_fulfill_pending()
+        assert not conn.replies and not conn.sent
+        assert len(h2._pending_leases) == 1
+        # quiet period reached -> window lifts early -> next pass grants
+        h2._last_node_reg_ts = time.monotonic() - 1.0
+        h2._try_fulfill_pending()
+        assert not h2._pending_leases
+        granted = conn.replies or conn.sent
+        assert granted, "lease never granted after grace lifted"
+        types = [ev[5] for ev in h2.cluster_events]
+        assert "head_grace_ended" in types
+        # the WINDOW itself stays armed for the restored-entity flush
+        # (it must not lift early with scheduling) until its deadline
+        assert h2._grace_until > 0.0
+        h2._grace_until = time.monotonic() - 0.01
+        assert not h2._grace_active()
+    finally:
+        h2.shutdown()
+
+
+# ------------------------------------------------- chaos: real processes
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_env():
+    import os
+    import sys
+
+    import ray_tpu as _pkg
+
+    pkg_parent = os.path.dirname(os.path.dirname(
+        os.path.abspath(_pkg.__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _start_head_proc(port, session_dir, log_path):
+    """A real head PROCESS on a fixed port + session dir (killable and
+    restartable — `python -m ray_tpu start --head`)."""
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "--address-file",
+         f"{session_dir}/address", "start", "--head", "--port", str(port),
+         "--session-dir", session_dir, "--num-cpus", "0"],
+        env=_spawn_env(), stdout=open(log_path, "ab"),
+        stderr=subprocess.STDOUT)
+    _wait_tcp(port)
+    return proc
+
+
+def _wait_tcp(port, timeout=60):
+    import socket
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            s = socket.create_connection(("127.0.0.1", port), timeout=1)
+            s.close()
+            return
+        except OSError:
+            time.sleep(0.1)
+    raise TimeoutError(f"head port {port} never came up")
+
+
+def _start_agent_proc(addr, num_cpus, log_path):
+    import subprocess
+    import sys
+
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.node_agent", "--address",
+         addr, "--num-cpus", str(num_cpus)],
+        env=_spawn_env(), stdout=open(log_path, "ab"),
+        stderr=subprocess.STDOUT, start_new_session=True)
+
+
+def _stop_proc(proc, sig=None):
+    import signal as _sig
+
+    if proc is None or proc.poll() is not None:
+        return
+    try:
+        proc.send_signal(sig or _sig.SIGTERM)
+        proc.wait(timeout=10)
+    except Exception:
+        try:
+            proc.kill()
+            proc.wait(timeout=5)
+        except Exception:
+            pass
+
+
+def test_head_crash_restart_cluster_survives(tmp_path):
+    """THE r12 acceptance scenario (reference:
+    test_gcs_fault_tolerance.py): kill -9 the head with 2 live agent
+    nodes and in-flight tasks; restart it on the same port + session
+    dir within head_reconnect_timeout_s. The SAME driver (no new
+    init()) finishes its workload, the named actor answers with its
+    pre-crash state intact, and a pre-crash object is still gettable —
+    the directory was rebuilt from the agents' holder reports."""
+    import os
+    import signal
+    import time
+
+    import ray_tpu
+    from ray_tpu import state as state_api
+
+    port = _free_port()
+    session_dir = str(tmp_path / "sess")
+    os.makedirs(session_dir, exist_ok=True)
+    addr = f"tcp:127.0.0.1:{port}"
+    head = head2 = None
+    agents = []
+    try:
+        head = _start_head_proc(port, session_dir,
+                                str(tmp_path / "head1.log"))
+        agents = [
+            _start_agent_proc(addr, 2, str(tmp_path / f"agent{i}.log"))
+            for i in range(2)]
+        ray_tpu.init(address=addr, num_cpus=0)
+        deadline = time.monotonic() + 60
+        while len([n for n in ray_tpu.nodes() if n["alive"]]) < 4:
+            assert time.monotonic() < deadline, "agents never joined"
+            time.sleep(0.2)
+
+        @ray_tpu.remote(num_cpus=1)
+        def slow(i):
+            import time as _t
+
+            _t.sleep(3)
+            return i * 2
+
+        @ray_tpu.remote(num_cpus=1)
+        def big():
+            return np.arange(80_000, dtype=np.float64)
+
+        @ray_tpu.remote(num_cpus=1)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.options(name="chaos_svc").remote()
+        assert ray_tpu.get(c.incr.remote(), timeout=90) == 1
+        big_ref = big.remote()
+        ready, _ = ray_tpu.wait([big_ref], num_returns=1, timeout=90)
+        assert ready, "pre-crash object never sealed"
+
+        refs = [slow.remote(i) for i in range(6)]  # in-flight workload
+        time.sleep(1.0)
+        os.kill(head.pid, signal.SIGKILL)  # the cluster-ending event
+        head.wait(timeout=10)
+        time.sleep(1.0)
+        head2 = _start_head_proc(port, session_dir,
+                                 str(tmp_path / "head2.log"))
+
+        # the SAME driver finishes its in-flight workload
+        assert ray_tpu.get(refs, timeout=120) == [i * 2 for i in range(6)]
+        # the named actor answers AND kept its pre-crash state (the
+        # surviving worker re-claimed it; a WAL reschedule would have
+        # reset the counter)
+        h = ray_tpu.get_actor("chaos_svc")
+        assert ray_tpu.get(h.incr.remote(), timeout=90) == 2
+        # a pre-crash object is still fetchable: the restarted head's
+        # directory was rebuilt from holder reports, not the WAL
+        arr = ray_tpu.get(big_ref, timeout=90)
+        assert np.array_equal(arr, np.arange(80_000, dtype=np.float64))
+        # fresh post-restart work schedules too
+        assert ray_tpu.get(slow.remote(10), timeout=120) == 20
+        row = state_api.io_loop_stats()[0]
+        assert row["node_reattaches"] >= 3  # 2 agents + driver's agent
+        assert row["client_reconnects"] >= 3
+        assert row["actor_reclaims"] >= 1
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        for a in agents:
+            _stop_proc(a)
+        _stop_proc(head)
+        _stop_proc(head2)
+
+
+def test_head_loss_fail_fast_past_deadline(tmp_path):
+    """With the head gone for GOOD, the reconnecting channel gives up
+    after head_reconnect_timeout_s and surfaces the pre-r12 fail-fast
+    ConnectionLost — it must not park callers forever."""
+    import os
+    import signal
+    import time
+
+    import ray_tpu
+    from ray_tpu.core import protocol as P
+    from ray_tpu.core.context import get_context
+
+    port = _free_port()
+    session_dir = str(tmp_path / "sess")
+    os.makedirs(session_dir, exist_ok=True)
+    head = None
+    try:
+        head = _start_head_proc(port, session_dir,
+                                str(tmp_path / "head.log"))
+        ray_tpu.init(address=f"tcp:127.0.0.1:{port}", num_cpus=0,
+                     _system_config={"head_reconnect_timeout_s": 3.0})
+        assert get_context().kv_put("ns", "k", b"v")
+        os.kill(head.pid, signal.SIGKILL)
+        head.wait(timeout=10)
+        t0 = time.monotonic()
+        with pytest.raises((P.ConnectionLost, TimeoutError)):
+            get_context().kv_get("ns", "k")
+        assert time.monotonic() - t0 < 25, (
+            "fail-fast took far longer than the reconnect window")
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        _stop_proc(head)
