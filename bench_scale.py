@@ -126,9 +126,59 @@ def bench_many_actors(n: int) -> dict:
     return result
 
 
+def _quiesce_workers(max_wait_s: float = 120.0) -> dict:
+    """Wait for the PRIOR phase's worker processes to exit before the
+    next phase's t0 (r13: the r11 run's many_pgs started seconds after
+    a 6s task wave, so up to 16 live interpreters were still burning
+    the host's 2 cores through the measured window — its rate was not
+    comparable across rounds). The idle keep-alive is shrunk so the
+    head's reaper drains the pool promptly, then restored; the JSON
+    records how long the drain took and how many workers were live at
+    entry so a quiesce that times out is visible, not silent."""
+    import time as _t
+
+    from ray_tpu import state
+    from ray_tpu.core.config import get_config
+
+    from ray_tpu.core.context import get_context
+
+    driver_id = get_context().worker_id
+
+    def _live():
+        # the worker table keeps "dead" rows for post-mortems, and the
+        # DRIVER registers as a (never-reaped, never-leased) worker —
+        # only live task interpreters burn CPU through the window
+        return [w for w in state.list_workers(limit=10000)
+                if w.get("state") != "dead"
+                and w.get("worker_id") != driver_id]
+
+    cfg = get_config()
+    prev_keep = cfg.idle_worker_keep_alive_s
+    cfg.idle_worker_keep_alive_s = 0.5
+    t0 = _t.perf_counter()
+    before = len(_live())
+    try:
+        deadline = t0 + max_wait_s
+        while _t.perf_counter() < deadline:
+            if not _live():
+                break
+            _t.sleep(0.25)
+    finally:
+        cfg.idle_worker_keep_alive_s = prev_keep
+    remaining = len(_live())
+    # settle: freshly-reaped interpreters can take a beat to actually
+    # exit (signal delivery + interpreter teardown)
+    _t.sleep(1.0)
+    return {"workers_at_entry": before,
+            "workers_remaining": remaining,
+            "quiesce_seconds": round(_t.perf_counter() - t0, 2)}
+
+
 def bench_many_pgs(n: int) -> dict:
     """Placement-group create->ready->remove churn (pure control plane:
-    bundle reservation 2PC + shadow-resource accounting, no workers)."""
+    bundle reservation 2PC + shadow-resource accounting, no workers).
+    Runs from a QUIESCED cluster: the prior task wave's workers must
+    have exited before t0 (see _quiesce_workers)."""
     import ray_tpu
 
     # bundles sized so all n PGs fit the virtual cluster's CPU capacity
@@ -250,8 +300,10 @@ def main():
 
         if "pgs" in phases:
             print(f"# many_pgs({args.pgs})", file=sys.stderr, flush=True)
+            quiesce = _quiesce_workers()  # task-wave workers must exit
             lag.snap()
             result["many_pgs"] = bench_many_pgs(args.pgs)
+            result["many_pgs"]["quiesce"] = quiesce
             result["many_pgs"]["loop_lag"] = lag.delta()
             print(json.dumps(result["many_pgs"]), file=sys.stderr)
             flush()

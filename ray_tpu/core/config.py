@@ -109,6 +109,51 @@ class Config:
     # for uplink emulation.
     host_egress_limit_bps: int = 0
 
+    # --- device path (r13) ---
+    # Typed zero-copy serialization for ``jax.Array`` (and large
+    # non-contiguous ``np.ndarray``): the reducer emits dtype/shape
+    # metadata in frame 0 and the array payload as an out-of-band
+    # buffer VIEW of the source array's host buffer — no
+    # device_get-then-pickle intermediate copy — so ``put_serialized``
+    # writes device bytes straight into the mapped arena. On the read
+    # side ``deserialize`` rebuilds through dlpack /
+    # ``jax.numpy.asarray`` from the arena-backed view: a consumer pays
+    # at most one host->device import (zero copies where XLA supports
+    # aliased dlpack import; exactly one transfer on TPU), and plain
+    # ndarray consumers alias arena memory outright — the store's
+    # borrow-pin ledger keeps the arena slice alive while any such view
+    # is (see ``ShmObjectStore.get_frames(pin_borrows=True)``). False
+    # restores the pre-r13 in-band pickle path (the A/B control for
+    # bench_device_path.py).
+    serialization_device_zero_copy: bool = True
+
+    # --- speculative arg prefetch (r13) ---
+    # At lease grant — and again at driver dispatch via PREFETCH_HINT,
+    # since leases are long-lived and serve many tasks — the head checks
+    # the granted node's directory entry against the task's deduped
+    # by-ref arg ids and fires a prefetch-flagged PULL_OBJECT at that
+    # node's agent for every missing arg, so the pull overlaps the lease
+    # reply, driver dispatch and worker wakeup instead of starting cold
+    # inside the worker's _decode_args (the reference PullManager's
+    # prefetch role; FETCHING_ARGS phase overlap). The worker's get()
+    # joins the in-flight pull via the puller's _pending leadership.
+    # False disables both the grant-time and hint-driven prefetch (the
+    # A/B control).
+    arg_prefetch_enabled: bool = True
+    # Per-destination-node bound on concurrent prefetch pulls. The caps
+    # PACE rather than drop (the reference PullManager's bounded pull
+    # activation): requests over the caps queue per node (bounded FIFO,
+    # 256) and activate as PREFETCH_RESULTs free slots, re-checking
+    # holders/caps/lease liveness at activation. <= 0 disables
+    # prefetching entirely.
+    arg_prefetch_max_inflight: int = 4
+    # Per-destination-node bound on the total bytes of in-flight
+    # prefetch pulls; over-cap requests wait in the same pending queue
+    # (a misconfigured cap shows up as doctor_warnings()'s prefetch
+    # waste-ratio warning or as joins instead of warm hits, not as
+    # arena pressure).
+    arg_prefetch_max_bytes: int = 256 * 1024 * 1024
+
     # --- scheduling ---
     # Hybrid scheduling policy: prefer local node until its utilization
     # exceeds this, then spread (reference: scheduler_spread_threshold).

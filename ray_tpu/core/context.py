@@ -573,7 +573,12 @@ class CoreContext:
             # means zero transfer RPCs and zero bytes moved.
             self.head.call(P.OBJECT_TRANSFER, oid.binary(), self.node_idx,
                            timeout=120)
-        frames = self.store.get_frames(oid)
+        # pin_borrows: out-of-band frames come back as ledger-tracked
+        # views, so a value that ALIASES arena memory (numpy oob
+        # reconstruction, the r13 device-array rebuild) keeps the entry
+        # pinned for its own lifetime — a free/spill racing the live
+        # view defers instead of recycling the slot under it
+        frames = self.store.get_frames(oid, pin_borrows=True)
         if frames is None:
             raise ObjectLostError(f"{oid.hex()} not in local store")
         self._pinned.add(oid)
@@ -1048,6 +1053,7 @@ class CoreContext:
                 worker.idle_since = time.monotonic()
             if not batch:
                 continue
+            self._send_prefetch_hint(worker, batch)
             try:
                 if len(batch) == 1:
                     worker.conn.send(P.PUSH_TASK, batch[0], 0)
@@ -1065,6 +1071,35 @@ class CoreContext:
                 pass
             w.conn.on_close = None
             w.conn.close()
+
+    def _send_prefetch_hint(self, worker, batch) -> None:
+        """Dispatch-time speculative prefetch (r13): name the pushed
+        batch's by-ref args for the lease's node so the head can start
+        any missing pulls while the batch is still in flight to the
+        worker — leases are long-lived, so the grant-time hint covers
+        only the first task. One one-way frame per batch-with-refs
+        (coalesced by the wire layer); tasks without by-ref args (the
+        common case at high rates) pay nothing."""
+        if not get_config().arg_prefetch_enabled:
+            return
+        # NEVER block dispatch on the head channel: during a head
+        # outage a ReconnectingConnection PARKS writes for the whole
+        # reconnect window, and this send runs on the submitter thread
+        # right before pushing tasks to healthy leased workers — a
+        # parked hint would stall all dispatch for the outage, undoing
+        # the r12 availability. Speculation just skips the window.
+        attached = getattr(self.head, "_attached", None)
+        if attached is not None and not attached.is_set():
+            return
+        ids = list(dict.fromkeys(
+            enc[1] for spec in batch for enc in spec.args
+            if enc[0] == ARG_REF))[:64]
+        if not ids:
+            return
+        try:
+            self.head.send(P.PREFETCH_HINT, worker.lease_id, ids)
+        except P.ConnectionLost:
+            pass  # speculation only: the demand path still works
 
     def _request_lease(self, cls, st: _ClassState):
         from .serialization import dumps

@@ -144,6 +144,34 @@ class _TaskTimeline:
     straggler_ms: float = 0.0
 
 
+@dataclass
+class _PrefetchState:
+    """One speculative arg pull (r13): fired at lease grant or dispatch
+    hint, keyed (oid_bin, node_idx). ``charged`` is the broadcast
+    planner's source-load registration, released exactly once — by the
+    agent's PREFETCH_RESULT or the TTL sweep. ``consumed`` flips when a
+    demand fetch for the same (object, node) arrives (the overlap the
+    feature exists for); unconsumed in-flight entries at lease teardown
+    are aborted and counted wasted."""
+
+    oid_bin: bytes
+    node_idx: int
+    lease_id: str
+    size: int
+    ts: float
+    charged: list = field(default_factory=list)
+    state: str = "inflight"  # inflight | done | aborted
+    consumed: bool = False
+
+
+# inflight/aborted prefetch entries whose agent never answered (died,
+# or the frame was lost) are swept — charges released — after this long;
+# completed entries linger briefly so a late demand fetch still counts
+# as satisfied-by-prefetch before the record is dropped.
+_PREFETCH_SWEEP_S = 180.0
+_PREFETCH_DONE_TTL_S = 60.0
+
+
 # task.phase_ms / task.node_phase_ms bucket bounds (milliseconds): task
 # phases span sub-ms dispatch hops to multi-minute training steps.
 TASK_PHASE_MS_BOUNDARIES = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
@@ -283,6 +311,28 @@ class Head:
         self.broadcast_relay_assignments = 0
         self.broadcast_fanout_saturations = 0
         self._last_saturation_event_ts = 0.0
+        # Speculative arg prefetch (r13, the reference PullManager's
+        # prefetch role): (oid_bin, node_idx) -> _PrefetchState for
+        # pulls fired at lease grant / dispatch hint, ahead of worker
+        # demand. Entries hold the broadcast-planner source charges
+        # until the agent's PREFETCH_RESULT (or the TTL sweep) releases
+        # them; lease teardown aborts unconsumed in-flight entries
+        # through PULL_ABORT (counted wasted).
+        self._prefetches: Dict[Tuple[bytes, int], _PrefetchState] = {}
+        self._prefetch_by_lease: Dict[str, List[Tuple[bytes, int]]] = {}
+        # caps pace, they don't drop (the reference PullManager's
+        # bounded pull activation): requests denied by the
+        # inflight/byte caps queue per node and activate as
+        # PREFETCH_RESULTs free slots. Bounded FIFO; entries re-check
+        # holders/caps/lease liveness at activation time.
+        self._prefetch_pending: Dict[int, "deque"] = {}
+        self._prefetch_draining: Set[int] = set()
+        self._prefetch_lock = threading.Lock()
+        self.prefetch_issued = 0     # speculative pulls fired
+        self.prefetch_joined = 0     # demand fetches that overlapped one
+        self.prefetch_completed = 0  # pulls that landed their copy
+        self.prefetch_wasted = 0     # aborted: task cancelled/retried
+        self.prefetch_bytes_issued = 0
         # Worker spawner queue (drained by the spawner thread, started in
         # start()): created here so _try_grant can enqueue spawns even on
         # heads that are never start()ed (unit tests drive handlers
@@ -830,6 +880,10 @@ class Head:
         if node is None:
             return
         node.alive = False
+        # prefetches aimed at the dead host can never land: drop them
+        # and release their source charges (no waste counting — host
+        # loss, not task churn)
+        self._purge_node_prefetches(idx)
         self.emit_event(
             "ERROR", "head", "node_dead",
             f"node {idx} removed"
@@ -1204,6 +1258,7 @@ class Head:
         TASK_DONE_BATCH). Requests that stay ungrantable remain queued;
         anything that frees resources re-signals the dispatcher."""
         by_conn: Dict[P.Connection, list] = {}
+        prefetch_jobs: List[tuple] = []
         with self._lock:
             if not self._pending_leases:
                 return
@@ -1234,6 +1289,13 @@ class Head:
                 by_conn.setdefault(conn, []).append(
                     (rid, worker.worker_id, worker.listen_addr, lease_id,
                      tpu_ids))
+                if arg_ids:
+                    # speculative arg prefetch (r13): issued AFTER the
+                    # lock drops, in this same pass, so the pull runs
+                    # while the lease reply / driver dispatch / worker
+                    # wakeup are still in flight
+                    prefetch_jobs.append(
+                        (lease_id, worker.node_idx, arg_ids))
         if not by_conn:
             return
         batch_max = get_config().lease_grant_batch_max
@@ -1255,6 +1317,8 @@ class Head:
                 # return to the pool instead of leaking.
                 for _rid, wid, _addr, lease_id, _tpu in grants:
                     self._h_return_worker(conn, 0, lease_id, wid)
+        for lease_id, node_idx, arg_ids in prefetch_jobs:
+            self._maybe_prefetch_args(lease_id, node_idx, arg_ids)
 
     def _try_grant(self, sched_class, request: ResourceSet, strategy,
                    demand: int = 1, arg_ids=None
@@ -1583,6 +1647,16 @@ class Head:
         return w
 
     def _h_return_worker(self, conn, rid, lease_id, worker_id, dispose=False):
+        try:
+            self._return_worker_inner(lease_id, worker_id, dispose)
+        finally:
+            # runs even when the lease or its node is already gone
+            # (node death raced the return): the lease's unconsumed
+            # prefetches are stale speculation either way, and their
+            # per-lease records must not accumulate across churn
+            self._abort_lease_prefetches(lease_id)
+
+    def _return_worker_inner(self, lease_id, worker_id, dispose):
         with self._lock:
             lease = self.leases.pop(lease_id, None)
             if lease is None:
@@ -1634,6 +1708,8 @@ class Head:
                         node.resources.release(request)
                     self._release_tpu_chips(node, tpu_ids)
             actor_id = w.actor_id
+        if w.lease_id:
+            self._abort_lease_prefetches(w.lease_id)
         if unexpected:
             self.emit_event("WARNING", "head", "worker_died",
                             f"worker {w.worker_id[:8]} died",
@@ -2409,10 +2485,286 @@ class Head:
             extra={"object_id": oid.hex(), "dst_node": dst_idx,
                    "saturations": self.broadcast_fanout_saturations})
 
+    # ---------------------------------- speculative arg prefetch (r13)
+
+    def _maybe_prefetch_args(self, lease_id: str, node_idx: int,
+                             arg_ids) -> int:
+        """Fire prefetch-flagged PULL_OBJECTs at ``node_idx``'s agent
+        for every by-ref arg its directory entry is missing (the
+        reference PullManager's prefetch role). Called off the head
+        lock — from the dispatch pass right after the lease replies go
+        out, and from the driver's dispatch-time PREFETCH_HINT — so the
+        pulls overlap the lease reply, driver dispatch and worker
+        wakeup; the worker's ``_decode_args`` get() then JOINS the
+        in-flight pull via the agent puller's ``_pending`` leadership
+        instead of starting cold. Remote nodes only: a head-local
+        node's consumers share the head host's arenas, where the demand
+        path is an in-memory hop. Returns how many pulls were issued."""
+        cfg = get_config()
+        if not cfg.arg_prefetch_enabled or \
+                cfg.arg_prefetch_max_inflight <= 0 or not arg_ids:
+            return 0
+        with self._lock:
+            node = self.nodes.get(node_idx)
+            if node is None or not node.alive or node.agent_conn is None \
+                    or lease_id not in self.leases:
+                return 0
+            conn = node.agent_conn
+        issued = 0
+        for ab in dict.fromkeys(bytes(a) for a in arg_ids):
+            oid = ObjectID(ab)
+            loc = self.objects.get(oid)
+            if loc is None or loc.size <= 0 or loc.spilled_path:
+                continue  # unknown size / spilled: demand path handles
+            if node_idx in loc.holders or loc.node_idx == node_idx:
+                continue  # already local: nothing to overlap
+            if loc.size > cfg.arg_prefetch_max_bytes:
+                # can NEVER fit under the byte cap: queueing it would
+                # churn forever (every drain re-queues it); the demand
+                # path handles oversized args
+                continue
+            key = (ab, node_idx)
+            with self._prefetch_lock:
+                if key in self._prefetches:
+                    continue  # in flight or freshly landed: dedupe
+                infl = [p for p in self._prefetches.values()
+                        if p.node_idx == node_idx
+                        and p.state == "inflight"]
+                if len(infl) >= cfg.arg_prefetch_max_inflight or \
+                        sum(p.size for p in infl) + loc.size > \
+                        cfg.arg_prefetch_max_bytes:
+                    # over the caps: QUEUE, don't drop — the next
+                    # PREFETCH_RESULT activates it (bounded per node)
+                    q = self._prefetch_pending.setdefault(
+                        node_idx, deque())
+                    if len(q) < 256 and \
+                            not any(e[1] == ab for e in q):
+                        q.append((lease_id, ab))
+                    continue
+                p = _PrefetchState(oid_bin=ab, node_idx=node_idx,
+                                   lease_id=lease_id, size=loc.size,
+                                   ts=time.monotonic())
+                self._prefetches[key] = p
+                self._prefetch_by_lease.setdefault(
+                    lease_id, []).append(key)
+            # plan OUTSIDE the prefetch lock (shard locks inside): the
+            # cooperative planner charges the chosen sources and lists
+            # the destination in-progress, so later pullers of the same
+            # object may relay off the prefetching node (r9 tree)
+            addrs, relays, max_sources, charged = \
+                self._plan_pull_sources(oid, loc, node)
+            if not addrs:
+                with self._prefetch_lock:
+                    self._unlink_prefetch_locked(key, p)
+                continue
+            released = None
+            with self._prefetch_lock:
+                if self._prefetches.get(key) is not p:
+                    # purged while planning (node died between the two
+                    # locks): the entry is gone, so nothing will ever
+                    # answer for these charges — release them here
+                    released = charged
+                else:
+                    p.charged = charged
+            if released:
+                self._finish_pull_assignment(oid, node_idx, released)
+                continue
+            try:
+                conn.send(P.PULL_OBJECT, ab, addrs, loc.size,
+                          max_sources, list(relays), True)
+            except P.ConnectionLost:
+                self._prefetch_finished(ab, node_idx, ok=False)
+                continue
+            with self._prefetch_lock:
+                self.prefetch_issued += 1
+                self.prefetch_bytes_issued += loc.size
+            issued += 1
+        return issued
+
+    def _h_prefetch_hint(self, conn, rid, lease_id, arg_bins):
+        """Driver dispatch-time prefetch (PREFETCH_HINT): leases are
+        long-lived and serve many tasks, so grant-time args cover only
+        the first — the submitter names each pushed batch's by-ref args
+        for the lease's node and the same caps/dedupe apply."""
+        with self._lock:
+            lease = self.leases.get(lease_id)
+        if lease is None:
+            return  # lease already returned: nothing to speculate for
+        self._maybe_prefetch_args(lease_id, lease[0], arg_bins)
+
+    def _h_prefetch_result(self, conn, rid, oid_bin, node_idx, ok):
+        self._prefetch_finished(bytes(oid_bin), int(node_idx), bool(ok))
+
+    def _prefetch_finished(self, oid_bin: bytes, node_idx: int,
+                           ok: bool):
+        """A speculative pull ended (agent PREFETCH_RESULT, send
+        failure, or TTL sweep): release the planner charges exactly
+        once; successful pulls linger as ``done`` so a late demand
+        fetch still reads as satisfied-by-prefetch."""
+        key = (oid_bin, node_idx)
+        with self._prefetch_lock:
+            p = self._prefetches.get(key)
+            if p is None or p.state == "done":
+                return
+            charged, p.charged = p.charged, []
+            if ok and p.state == "inflight":
+                p.state = "done"
+                p.ts = time.monotonic()
+                self.prefetch_completed += 1
+            else:
+                self._unlink_prefetch_locked(key, p)
+        if charged:
+            self._finish_pull_assignment(ObjectID(oid_bin), node_idx,
+                                         charged)
+        # a result frees an inflight slot: activate queued requests
+        self._drain_prefetch_pending(node_idx)
+
+    def _drain_prefetch_pending(self, node_idx: int):
+        """Activate cap-queued prefetch requests while slots last (the
+        reference PullManager's bounded activation loop). Entries
+        re-check holders/caps/lease liveness through the normal issue
+        path; one still-over-caps entry re-queues and stops the drain
+        until the next slot frees. Reentrancy-guarded per node: an
+        issue failure inside the drain reports through
+        _prefetch_finished, which calls back here."""
+        while True:
+            with self._prefetch_lock:
+                if node_idx in self._prefetch_draining:
+                    return
+                q = self._prefetch_pending.get(node_idx)
+                if not q:
+                    return
+                lease_id, ab = q.popleft()
+                self._prefetch_draining.add(node_idx)
+            try:
+                issued = self._maybe_prefetch_args(lease_id, node_idx,
+                                                   [ab])
+            finally:
+                with self._prefetch_lock:
+                    self._prefetch_draining.discard(node_idx)
+            if issued == 0:
+                with self._prefetch_lock:
+                    requeued = any(
+                        e[1] == ab for e in
+                        self._prefetch_pending.get(node_idx, ()))
+                if requeued:
+                    return  # caps still full: wait for the next slot
+
+    def _abort_lease_prefetches(self, lease_id: str):
+        """Lease teardown (worker returned/died, driver gone, task
+        cancelled or retried elsewhere): abort this lease's unconsumed
+        in-flight prefetches through the r9 abort path and count them
+        wasted; satisfied entries just drop their records."""
+        aborts: List[_PrefetchState] = []
+        with self._prefetch_lock:
+            for q in self._prefetch_pending.values():
+                # cap-queued requests of the dead lease never activate
+                stale = [e for e in q if e[0] == lease_id]
+                for e in stale:
+                    q.remove(e)
+            keys = self._prefetch_by_lease.pop(lease_id, None)
+            if not keys:
+                return
+            for key in keys:
+                p = self._prefetches.get(key)
+                if p is None:
+                    continue
+                if p.state == "done":
+                    self._prefetches.pop(key, None)  # list popped above
+                elif p.state == "inflight" and not p.consumed:
+                    p.state = "aborted"
+                    self.prefetch_wasted += 1
+                    aborts.append(p)
+                # consumed in-flight entries: a demand fetch is riding
+                # the pull — leave it to finish; PREFETCH_RESULT (or
+                # the sweep) releases the charges and drops the entry
+        for p in aborts:
+            with self._lock:
+                node = self.nodes.get(p.node_idx)
+                conn = node.agent_conn if node is not None else None
+            if conn is not None:
+                try:
+                    conn.send(P.PULL_ABORT, p.oid_bin)
+                except P.ConnectionLost:
+                    pass
+
+    def _prefetch_inflight_count(self) -> int:
+        with self._prefetch_lock:  # stats poll races insert/pop threads
+            return sum(1 for p in self._prefetches.values()
+                       if p.state == "inflight")
+
+    def _unlink_prefetch_locked(self, key, p: "_PrefetchState"):
+        """Drop an entry AND its per-lease record (caller holds
+        _prefetch_lock). Every pop must route through here: a
+        long-lived lease issues prefetches for the whole stream of
+        tasks it serves, and per-lease key lists pruned only at lease
+        teardown would grow for the lease's entire lifetime."""
+        self._prefetches.pop(key, None)
+        keys = self._prefetch_by_lease.get(p.lease_id)
+        if keys is not None:
+            if key in keys:
+                keys.remove(key)
+            if not keys:
+                del self._prefetch_by_lease[p.lease_id]
+
+    def _purge_node_prefetches(self, node_idx: int):
+        """Node death: drop every prefetch targeted at it (charges on
+        surviving sources released; no waste counting — this is host
+        loss, not task churn)."""
+        dead: List[_PrefetchState] = []
+        with self._prefetch_lock:
+            self._prefetch_pending.pop(node_idx, None)
+            for key in [k for k in self._prefetches
+                        if k[1] == node_idx]:
+                p = self._prefetches[key]
+                if p.charged:
+                    dead.append(p)
+                self._unlink_prefetch_locked(key, p)
+        for p in dead:
+            self._finish_pull_assignment(ObjectID(p.oid_bin),
+                                         p.node_idx, p.charged)
+
+    def _sweep_prefetches(self):
+        """Housekeeping: entries whose agent never answered (died, frame
+        lost) release their charges after ``_PREFETCH_SWEEP_S``; done
+        records drop after ``_PREFETCH_DONE_TTL_S``."""
+        now = time.monotonic()
+        expired: List[_PrefetchState] = []
+        with self._prefetch_lock:
+            for key, p in list(self._prefetches.items()):
+                if p.state == "done":
+                    if now - p.ts > _PREFETCH_DONE_TTL_S:
+                        self._unlink_prefetch_locked(key, p)
+                elif now - p.ts > _PREFETCH_SWEEP_S:
+                    self._unlink_prefetch_locked(key, p)
+                    if p.charged:
+                        expired.append(p)
+            stalled = [idx for idx, q in self._prefetch_pending.items()
+                       if q]
+        for p in expired:
+            self._finish_pull_assignment(ObjectID(p.oid_bin),
+                                         p.node_idx, p.charged)
+        for idx in stalled:
+            # expired entries freed slots without a PREFETCH_RESULT
+            # (that's what expired them) — the drain is the only other
+            # activation edge, so run it or queued requests strand
+            self._drain_prefetch_pending(idx)
+
     def _p2p_transfer(self, oid: ObjectID, loc: _ObjLoc,
                       dst_node: NodeState) -> bool:
         """Direct host-to-host pull, sources chosen by the broadcast-
         aware planner; returns False to fall back to relay."""
+        with self._prefetch_lock:
+            p = self._prefetches.get((oid.binary(), dst_node.idx))
+            if p is not None and not p.consumed and \
+                    p.state in ("inflight", "done"):
+                # the demand fetch arrived while (or just after) the
+                # speculative pull ran: the agent-side puller joins the
+                # in-flight pull via _pending leadership, or finds the
+                # landed copy — either way the arg fetch started warm
+                p.consumed = True
+                if p.state == "inflight":
+                    self.prefetch_joined += 1
         addrs, relays, max_sources, charged = \
             self._plan_pull_sources(oid, loc, dst_node)
         if not addrs:
@@ -3143,6 +3495,18 @@ class Head:
                 self.broadcast_relay_assignments,
             "broadcast_fanout_saturations":
                 self.broadcast_fanout_saturations,
+            # speculative arg prefetch (r13): issued = speculative
+            # pulls fired at lease grant / dispatch hint; joined =
+            # demand fetches that overlapped one in flight; wasted =
+            # aborted as stale speculation (task cancelled / retried
+            # elsewhere before any worker asked) — doctor_warnings()
+            # flags a high wasted:issued ratio
+            "prefetch_issued": self.prefetch_issued,
+            "prefetch_joined": self.prefetch_joined,
+            "prefetch_completed": self.prefetch_completed,
+            "prefetch_wasted": self.prefetch_wasted,
+            "prefetch_bytes_issued": self.prefetch_bytes_issued,
+            "prefetch_inflight": self._prefetch_inflight_count(),
             # the head host's own transfer server, split by
             # source role (root = sealed copy, relay = re-served
             # in-progress partial); agent-side servers report
@@ -3179,6 +3543,24 @@ class Head:
                             "ring buffer",
              "tags": {}, "boundaries": None,
              "value": float(self.cluster_events_dropped)},
+            {"name": "object_plane.prefetch_issued",
+             "kind": "counter",
+             "description": "Speculative arg pulls fired at lease "
+                            "grant / dispatch hint (r13)",
+             "tags": {}, "boundaries": None,
+             "value": float(self.prefetch_issued)},
+            {"name": "object_plane.prefetch_joined",
+             "kind": "counter",
+             "description": "Demand arg fetches that joined an "
+                            "in-flight speculative pull",
+             "tags": {}, "boundaries": None,
+             "value": float(self.prefetch_joined)},
+            {"name": "object_plane.prefetch_wasted",
+             "kind": "counter",
+             "description": "Speculative pulls aborted as stale "
+                            "(task cancelled/retried elsewhere)",
+             "tags": {}, "boundaries": None,
+             "value": float(self.prefetch_wasted)},
             {"name": "head.reconnects",
              "kind": "counter",
              "description": "Head-channel reattachments "
@@ -3535,6 +3917,8 @@ class Head:
         P.SEAL_ABORTED: _h_seal_aborted,
         P.METRICS_REPORT: _h_metrics_report,
         P.XLANG_CALL: _h_xlang_call,
+        P.PREFETCH_RESULT: _h_prefetch_result,
+        P.PREFETCH_HINT: _h_prefetch_hint,
     }
 
     def _forward_to_worker(self, worker_id: str, mt: int, *fields):
@@ -3635,6 +4019,7 @@ class Head:
         self._health_check()
         self._retry_pending_pgs()
         self._try_fulfill_pending()
+        self._sweep_prefetches()
         # restored actors/PGs held back by the restart grace window are
         # rescheduled here once it lifts (no-op on fresh sessions and
         # after the first post-grace flush)

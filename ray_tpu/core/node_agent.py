@@ -191,17 +191,25 @@ class NodeAgent:
             elif mt == P.PULL_OBJECT:
                 # head says: fetch this object straight from peer hosts —
                 # msg carries the directory's holder-address list (or one
-                # addr string), the object size for stripe planning, and
-                # the broadcast planner's stripe cap + relay markers
+                # addr string), the object size for stripe planning, the
+                # broadcast planner's stripe cap + relay markers, and the
+                # r13 prefetch flag (speculative pull fired at lease
+                # grant/dispatch: one-way, acked via PREFETCH_RESULT)
                 oid, peers = ObjectID(msg[2]), msg[3]
                 size = msg[4] if len(msg) > 4 else -1
                 max_sources = msg[5] if len(msg) > 5 else 0
                 relays = msg[6] if len(msg) > 6 else ()
+                prefetch = bool(msg[7]) if len(msg) > 7 else False
                 threading.Thread(
                     target=self._do_pull,
                     args=(conn, rid, oid, peers, size, max_sources,
-                          relays),
+                          relays, prefetch),
                     daemon=True).start()
+            elif mt == P.PULL_ABORT:
+                # stale speculation: the prefetched task was cancelled /
+                # retried elsewhere — the puller honors this only for
+                # prefetch-flagged pulls no demand get() has joined
+                self.puller.abort(ObjectID(msg[2]))
             elif mt == P.AGENT_OBJ_FREE:
                 for ob in msg[2]:
                     self.store.delete(ObjectID(ob))
@@ -223,11 +231,11 @@ class NodeAgent:
 
     def _do_pull(self, conn: P.Connection, rid: int, oid: ObjectID,
                  peers, size: int = -1, max_sources: int = 0,
-                 relays=()):
+                 relays=(), prefetch: bool = False):
         try:
             ok = self.puller.pull(oid, peers, size_hint=size,
                                   max_sources=max_sources,
-                                  relay_addrs=relays)
+                                  relay_addrs=relays, prefetch=prefetch)
             if ok and self.node_idx is not None:
                 # report the gained copy so the directory lists this node
                 # as a holder independent of the broker path's bookkeeping
@@ -237,9 +245,27 @@ class NodeAgent:
                                    self.node_idx, max(size, 0))
                 except P.ConnectionLost:
                     pass
+            if prefetch:
+                # one-way speculative pull: no blocked caller to reply
+                # to — the result frame lets the head release the source
+                # charges it registered at issue time
+                try:
+                    conn.send(P.PREFETCH_RESULT, oid.binary(),
+                              self.node_idx if self.node_idx is not None
+                              else -1, ok)
+                except P.ConnectionLost:
+                    pass
+                return
             conn.reply(rid, ok)
         except Exception as e:  # noqa: BLE001
-            if rid > 0:
+            if prefetch:
+                try:
+                    conn.send(P.PREFETCH_RESULT, oid.binary(),
+                              self.node_idx if self.node_idx is not None
+                              else -1, False)
+                except P.ConnectionLost:
+                    pass
+            elif rid > 0:
                 try:
                     conn.reply_error(rid, e)
                 except P.ConnectionLost:

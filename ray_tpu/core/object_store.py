@@ -16,6 +16,7 @@ import os
 import pickle
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
@@ -198,6 +199,20 @@ class PartialObject:
             self._cond.notify_all()  # readers re-pin via store.get
 
 
+class _BorrowEntry:
+    """Live zero-copy views of one arena entry: a set of weakrefs to the
+    frame-view wrappers handed out by ``get_frames(pin_borrows=True)``,
+    plus whether a delete arrived while they were alive."""
+
+    __slots__ = ("refs", "deferred_delete")
+
+    def __init__(self):
+        # list, not set: weakrefs to ndarray views are unhashable
+        # (ndarray defines array __eq__); removal is by identity
+        self.refs: list = []
+        self.deferred_delete = False
+
+
 class ShmObjectStore:
     """One node's shared-memory object store (creator or attacher)."""
 
@@ -228,6 +243,32 @@ class ShmObjectStore:
         self._partials: Dict[ObjectID, PartialObject] = {}
         self._aborted: "deque" = deque()
         self._partials_lock = threading.Lock()
+        # Borrow-pin ledger (r13 zero-copy device path): consumers of
+        # ``get_frames(pin_borrows=True)`` receive out-of-band frames as
+        # weakref-able views; while ANY such view (or an array
+        # reconstructed over it — numpy's oob unpickling and the
+        # device-array rebuild both chain .base to the view) is alive,
+        # the ledger holds one extra native pin on the entry, so
+        # free/spill/evict can never recycle the arena slot under a
+        # live zero-copy alias. A delete() that lands while borrows are
+        # live is DEFERRED: it runs when the last view dies (the plasma
+        # client's release-on-last-buffer semantics).
+        self._borrows: Dict[ObjectID, "_BorrowEntry"] = {}
+        self._borrow_lock = threading.Lock()
+        # Dead-view processing runs on a dedicated reaper thread, NOT in
+        # the weakref callback: callbacks fire from GC on ANY allocation
+        # — including allocations made while _borrow_lock is held — and
+        # taking the (non-reentrant) lock there would self-deadlock the
+        # process. The callback only enqueues (deque.append is atomic)
+        # and wakes the reaper; ledger entries stay in the map until the
+        # reaper processes them, so a delete() racing the last view's
+        # death always finds somewhere to record its deferral.
+        self._borrow_reap_q: "deque" = deque()
+        self._borrow_reap_wake = threading.Event()
+        self._borrow_reap_busy: set = set()  # thread idents mid-release
+        self._borrow_reaper: Optional[threading.Thread] = None
+        self.borrow_pins_taken = 0
+        self.borrow_deferred_deletes = 0
         # Map the segment for data access (metadata is managed by the C side).
         fd = os.open(f"/dev/shm/{name}", os.O_RDWR)
         try:
@@ -352,7 +393,31 @@ class ShmObjectStore:
         # touching the arena view BEFORE the slot is freed for reuse —
         # _finish_partial blocks on in-flight relay reads.
         self._finish_partial(object_id, sealed=False)
-        return get_lib().shm_store_delete(self._h, object_id.binary()) == 0
+        ok = get_lib().shm_store_delete(self._h, object_id.binary()) == 0
+        if not ok:
+            # pinned — by a reader, or by the borrow ledger's extra pin
+            # while zero-copy views are alive. If it's the ledger,
+            # DEFER: the delete re-runs when the last view dies, so
+            # free/spill racing a live alias pins instead of corrupting.
+            # (Entries linger in the map until the reaper thread
+            # processes dead views, so this always finds somewhere to
+            # record the deferral.)
+            retry = False
+            with self._borrow_lock:
+                entry = self._borrows.get(object_id)
+                if entry is not None:
+                    if not entry.deferred_delete:
+                        entry.deferred_delete = True
+                        self.borrow_deferred_deletes += 1
+                else:
+                    # no ledger entry: the failing pin may have been the
+                    # ledger's, released between the two calls — retry
+                    # once so the delete isn't lost to that race
+                    retry = True
+            if retry:
+                ok = get_lib().shm_store_delete(
+                    self._h, object_id.binary()) == 0
+        return ok
 
     def evict(self, need: int) -> List[ObjectID]:
         if self._closed:
@@ -486,7 +551,16 @@ class ShmObjectStore:
             del data_v, meta_v, got
             self.release(object_id)
 
-    def get_frames(self, object_id: ObjectID) -> Optional[List[memoryview]]:
+    def get_frames(self, object_id: ObjectID, pin_borrows: bool = False
+                   ) -> Optional[List]:
+        """Frame views over the sealed entry (pins the object — the
+        caller owns one ``release``). With ``pin_borrows``, out-of-band
+        frames come back as weakref-able ndarray views registered with
+        the borrow ledger: deserialized arrays that alias them (numpy
+        oob reconstruction, the device-array rebuild) keep the views —
+        and therefore one extra native pin on the entry — alive, so a
+        racing free/spill defers instead of recycling the slot under
+        the consumer (zero-copy read safety)."""
         got = self.get(object_id)
         if got is None:
             return None
@@ -496,7 +570,136 @@ class ShmObjectStore:
         for s in sizes:
             frames.append(data[pos:pos + s])
             pos += s
+        if pin_borrows and len(frames) > 1:
+            wrapped = []
+            for f in frames[1:]:
+                w = np.frombuffer(f, dtype=np.uint8)
+                # READONLY, like the reference plasma client's sealed
+                # buffers: consumers alias SHARED arena memory, and an
+                # in-place `arr *= 2` must raise, not silently corrupt
+                # the object for every other reader (the device rebuild
+                # copies on readonly via its dlpack fallback)
+                w.setflags(write=False)
+                wrapped.append(w)
+            self._register_borrows(object_id, wrapped)
+            frames = [frames[0]] + wrapped
         return frames
+
+    # -- borrow-pin ledger (zero-copy read safety) ---------------------
+
+    def _register_borrows(self, object_id: ObjectID, views: List):
+        """One extra native pin per object-with-borrows, held until the
+        last registered view dies (processed by the reaper thread)."""
+        if self._closed:
+            return
+        with self._borrow_lock:
+            if self._borrow_reaper is None:
+                self._borrow_reaper = threading.Thread(
+                    target=self._borrow_reap_loop, daemon=True,
+                    name=f"borrow-reap-{self.name}")
+                self._borrow_reaper.start()
+            entry = self._borrows.get(object_id)
+            fresh = entry is None
+            if fresh:
+                entry = self._borrows[object_id] = _BorrowEntry()
+            for v in views:
+                entry.refs.append(weakref.ref(
+                    v, lambda r, oid=object_id: self._borrow_dead(oid, r)))
+        if fresh:
+            # the ledger's own pin (independent of the caller's read
+            # pin): bump the native refcount, drop the views
+            out = (ctypes.c_uint64 * 3)()
+            if get_lib().shm_store_get(self._h, object_id.binary(),
+                                       out) == 0:
+                self.borrow_pins_taken += 1
+            else:  # entry vanished between get_frames' get and here
+                with self._borrow_lock:
+                    self._borrows.pop(object_id, None)
+
+    def _borrow_dead(self, object_id: ObjectID, ref):
+        """Weakref callback — runs inside GC, possibly on a thread that
+        already holds _borrow_lock (callbacks fire on any allocation):
+        must not lock or call into the native store. Enqueue only."""
+        self._borrow_reap_q.append((object_id, ref))
+        self._borrow_reap_wake.set()
+
+    def _borrow_reap_loop(self):
+        while not self._closed:
+            self._borrow_reap_wake.wait(timeout=5.0)
+            self._borrow_reap_wake.clear()
+            self._drain_borrow_queue()
+
+    def _drain_borrow_queue(self):
+        """Process dead-view notifications: prune the ledger, release
+        the pin when the last view of an object dies, and run any
+        delete() that was deferred while views were alive. Safe to call
+        from any thread (items pop atomically; the ledger mutates under
+        its lock) — ``reap_borrows`` shares it with the reaper."""
+        me = threading.get_ident()
+        while True:
+            with self._borrow_lock:
+                try:
+                    object_id, ref = self._borrow_reap_q.popleft()
+                except IndexError:
+                    return
+                # mark in-progress UNDER the lock that popped the item:
+                # reap_borrows must not observe empty-queue-and-idle
+                # while another thread is mid-release
+                self._borrow_reap_busy.add(me)
+            try:
+                do_delete = False
+                with self._borrow_lock:
+                    entry = self._borrows.get(object_id)
+                    if entry is None:
+                        continue
+                    entry.refs = [r for r in entry.refs if r is not ref]
+                    if entry.refs:
+                        continue
+                    del self._borrows[object_id]
+                    do_delete = entry.deferred_delete
+                if self._closed:
+                    return
+                self.release(object_id)
+                if do_delete:
+                    # plain native delete: the entry is sealed (no
+                    # partial can exist) and delete() would re-consult
+                    # the ledger entry just removed. A transient reader
+                    # pin (a get() in flight on another thread) can
+                    # fail it — retry briefly; past that, reclamation
+                    # falls back to the normal directory-driven
+                    # free/eviction paths (same contract as the
+                    # owner-free local-delete optimization).
+                    for _ in range(5):
+                        if get_lib().shm_store_delete(
+                                self._h, object_id.binary()) == 0:
+                            break
+                        time.sleep(0.01)
+                        if self._closed:
+                            return
+            finally:
+                with self._borrow_lock:
+                    self._borrow_reap_busy.discard(me)
+
+    def reap_borrows(self, timeout: float = 2.0) -> None:
+        """Synchronously process every already-dead view's notification
+        (the reaper thread normally does this asynchronously) — for
+        tests and teardown paths that need deterministic reclamation."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self._drain_borrow_queue()
+            with self._borrow_lock:
+                if not self._borrow_reap_q and \
+                        not self._borrow_reap_busy:
+                    return
+            time.sleep(0.001)
+
+    def live_borrows(self, object_id: ObjectID) -> int:
+        """How many zero-copy views of this entry are still alive."""
+        with self._borrow_lock:
+            entry = self._borrows.get(object_id)
+            if entry is None:
+                return 0
+            return sum(1 for r in entry.refs if r() is not None)
 
     def close(self):
         if self._closed:

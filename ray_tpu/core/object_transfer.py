@@ -350,9 +350,17 @@ class _Range:
 class _PullState:
     __slots__ = ("buf", "done", "error", "buf_lock", "size", "ranges",
                  "conns", "addrs", "failed_addrs", "started",
-                 "planned_sources", "max_sources", "relay_addrs", "part")
+                 "planned_sources", "max_sources", "relay_addrs", "part",
+                 "prefetch", "joined")
 
     def __init__(self):
+        # speculative-prefetch bookkeeping (r13): ``prefetch`` marks a
+        # pull the head fired ahead of demand at lease grant/dispatch;
+        # ``joined`` flips when a demand get() attaches to it via the
+        # _pending leadership below — a joined prefetch is real work
+        # and must no longer be abortable
+        self.prefetch = False
+        self.joined = False
         self.buf = None
         self.done = threading.Event()
         self.error: Optional[str] = None
@@ -396,6 +404,9 @@ class ObjectPuller:
         self.pulls_completed = 0
         self.multi_source_pulls = 0
         self.source_failovers = 0
+        # demand get()s that attached to an in-flight prefetch pull
+        # instead of starting cold (the r13 overlap actually observed)
+        self.prefetch_joins = 0
 
     def _peer(self, addr: str) -> P.Connection:
         with self._lock:
@@ -414,7 +425,8 @@ class ObjectPuller:
              peer_addr: Union[str, Sequence[str]],
              timeout: float = 120.0, size_hint: int = -1,
              max_sources: int = 0,
-             relay_addrs: Sequence[str] = ()) -> bool:
+             relay_addrs: Sequence[str] = (),
+             prefetch: bool = False) -> bool:
         """Blocking: fetch ``oid`` into the local store.
 
         ``peer_addr`` is one transfer address or the holder list from the
@@ -426,6 +438,9 @@ class ObjectPuller:
         ``relay_addrs`` marks which candidates are IN-PROGRESS pullers:
         their OBJ_PULLs carry the broadcast serve-wait budget so the
         relay subscribes us to chunk arrival instead of failing fast.
+        ``prefetch`` marks a head-speculated pull (fired at lease
+        grant/dispatch, ahead of any worker demand): it is abortable via
+        ``abort()`` until a demand pull() joins it.
         """
         if self._store.contains(oid):
             return True
@@ -443,10 +458,20 @@ class ObjectPuller:
             st = self._pending.get(oid)
             if st is not None:
                 leader = False
+                if not prefetch and not st.joined:
+                    # a demand get() attaching to an in-flight pull: if
+                    # the leader was speculative, the join makes it real
+                    # work (no longer abortable) — THE r13 overlap: the
+                    # prefetch ran while dispatch was in flight and the
+                    # worker's arg fetch starts warm
+                    st.joined = True
+                    if st.prefetch:
+                        self.prefetch_joins += 1
             else:
                 st = self._pending[oid] = _PullState()
                 st.max_sources = max_sources
                 st.relay_addrs = frozenset(relay_addrs)
+                st.prefetch = prefetch
                 leader = True
         if not leader:  # another thread is already pulling this object
             st.done.wait(timeout)
@@ -699,6 +724,28 @@ class ObjectPuller:
         except KeyError:
             st.error = "seal failed"
         st.done.set()
+
+    def abort(self, oid: ObjectID) -> bool:
+        """Abort an in-flight PREFETCH pull (head PULL_ABORT: the task
+        that speculated it was cancelled / retried elsewhere). Only
+        prefetch-flagged pulls no demand get() has joined are honored —
+        a pull real work waits on is never killed by stale speculation.
+        The woken leader's cleanup path deletes the created-but-unsealed
+        arena entry (the r9 abort machinery: partial finished under the
+        entry lock, relays handed OBJ_PULL_FAIL, slot freed only after
+        in-flight reads drain)."""
+        with self._lock:
+            # same lock the follower path sets st.joined under: either
+            # the join serialized first (we back off) or the abort wins
+            # outright — a join can no longer slip between the check
+            # and the error write
+            st = self._pending.get(oid)
+            if st is None or not st.prefetch or st.joined:
+                return False
+            if st.error is None:
+                st.error = "prefetch aborted"
+        st.done.set()
+        return True
 
     # ---- source failure / striped-range failover ----
 

@@ -173,6 +173,28 @@ CLIENT_HELLO = 74       # client->head, one-way: (client_id, reattach) —
 #                         (reattach=True on every connect after the
 #                         first — the GCS-FT analog of a raylet
 #                         re-establishing its GCS RPC channel)
+PULL_ABORT = 76         # head->agent, one-way: (oid_bin,) — abort the
+#                         in-flight PREFETCH pull of this object (its
+#                         task was cancelled / retried elsewhere / its
+#                         lease died before any worker asked for the
+#                         arg). The agent's puller only honors it for
+#                         prefetch-flagged pulls no demand get() has
+#                         joined — a pull real work is waiting on is
+#                         never killed by stale speculation.
+PREFETCH_RESULT = 77    # agent->head, one-way: (oid_bin, node_idx, ok)
+#                         — a prefetch-flagged pull finished (either
+#                         way). The head releases the broadcast-planner
+#                         source charges it registered at issue time and
+#                         marks the entry done (ok) or drops it.
+PREFETCH_HINT = 78      # driver->head, one-way: (lease_id,
+#                         [arg_id_bins]) — dispatch-time companion to
+#                         the grant-time prefetch: leases are long-lived
+#                         and serve many tasks, so when the submitter
+#                         pushes a task batch with by-ref args it names
+#                         them for the lease's node; the head applies
+#                         the same holder check / caps / dedupe and
+#                         fires prefetch-flagged PULL_OBJECTs while the
+#                         batch is still in flight to the worker.
 OBJ_PULL_FAIL = 72      # server->puller: (oid_bin, offset) — the server
                         # cannot complete the requested range past
                         # `offset` (its own in-progress pull aborted, or

@@ -212,6 +212,19 @@ RECONNECT_STORM_THRESHOLD = 20
 # its node's re-registration is looping): the node is not fully back.
 REATTACH_STUCK_S = 15.0
 
+# Speculative arg prefetch (r13): wasted = pulls aborted because their
+# task was cancelled / retried elsewhere before any worker asked. Above
+# this fraction of issued — over the window since the previous
+# doctor_warnings() call, with a minimum sample — speculation is doing
+# more harm than good: caps are misconfigured for the workload, or
+# retry/cancel churn is re-placing tasks away from their prefetches.
+PREFETCH_WASTE_RATIO = 0.5
+PREFETCH_WASTE_MIN_ISSUED = 20
+# previous poll's cumulative counters, so repeated doctor calls judge
+# the WINDOW between them instead of diluting a recent regression in
+# the lifetime totals (first call judges the totals)
+_prefetch_last = {"issued": 0, "wasted": 0}
+
 
 def doctor_warnings() -> list:
     """Health warnings that are not endpoint failures: nonzero
@@ -281,6 +294,26 @@ def doctor_warnings() -> list:
                 "with the restarted head; they will be ghost-swept at "
                 "worker_register_timeout_s, check the node's worker "
                 "logs")
+    try:
+        op = state.object_plane_stats()
+    except Exception:  # noqa: BLE001
+        op = {}
+    issued = op.get("prefetch_issued", 0)
+    wasted = op.get("prefetch_wasted", 0)
+    d_issued = issued - _prefetch_last["issued"]
+    d_wasted = wasted - _prefetch_last["wasted"]
+    if d_issued < 0 or d_wasted < 0:  # head restarted: counters reset
+        d_issued, d_wasted = issued, wasted
+    _prefetch_last["issued"], _prefetch_last["wasted"] = issued, wasted
+    if d_issued >= PREFETCH_WASTE_MIN_ISSUED and \
+            d_wasted > PREFETCH_WASTE_RATIO * d_issued:
+        warns.append(
+            f"prefetch_wasted={d_wasted} of {d_issued} issued in this "
+            f"window (>{PREFETCH_WASTE_RATIO:.0%}): arg prefetch is "
+            "mostly stale speculation — task retry/cancel churn is "
+            "re-placing work away from its prefetches, or "
+            "arg_prefetch_max_bytes/_max_inflight are misconfigured "
+            "for the workload")
     return warns
 
 

@@ -1,0 +1,281 @@
+"""r13 zero-copy device path: typed jax.Array / ndarray serialization
+through the shm arena, and pin-while-borrowed safety.
+
+Ref analog: the reference's plasma store + serialization layer
+(python/ray/_private/serialization.py custom reducers over pickle5
+out-of-band buffers): device arrays move source-buffer -> arena -> consumer
+with no intermediate pickle-stream copy, and an arena entry stays pinned
+while any zero-copy view of it is alive (free/spill racing a live borrow
+must never recycle the slot under the view).
+"""
+
+import gc
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import ShmObjectStore
+
+ARENA = 96 * 1024 * 1024
+
+
+@pytest.fixture
+def store():
+    s = ShmObjectStore(f"rtpu_dp_{ObjectID.from_random().hex()[:8]}",
+                       ARENA, create=True)
+    yield s
+    s.close()
+
+
+def _put(store, value):
+    oid = ObjectID.from_random()
+    sv = serialization.serialize(value)
+    store.put_serialized(oid, sv.frames)
+    return oid
+
+
+# ------------------------------------------------- typed jax.Array reducer
+
+
+def test_jax_array_serializes_out_of_band():
+    """The device-array fast path: frame 0 carries only dtype/shape
+    metadata, the payload rides as an out-of-band buffer VIEW — no
+    in-band pickle copy of the array bytes (the pre-r13 path embedded
+    the whole payload in the pickle stream)."""
+    x = jnp.arange(1 << 18, dtype=jnp.float32)  # 1 MiB
+    sv = serialization.serialize(x)
+    assert len(sv.frames) >= 2, "payload must be out-of-band"
+    assert len(sv.frames[0]) < 4096, "frame 0 is metadata, not payload"
+    assert sum(len(f) for f in sv.frames[1:]) == x.nbytes
+    y = serialization.deserialize([bytes(f) for f in sv.frames])
+    assert isinstance(y, jax.Array)
+    assert y.dtype == x.dtype and y.shape == x.shape
+    assert np.array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_jax_array_roundtrip_through_arena(store):
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(512, 1024))
+                    .astype(np.float32))
+    oid = _put(store, x)
+    frames = store.get_frames(oid, pin_borrows=True)
+    y = serialization.deserialize(frames)
+    del frames
+    assert isinstance(y, jax.Array)
+    assert y.dtype == jnp.float32 and y.shape == (512, 1024)
+    assert np.array_equal(np.asarray(y), np.asarray(x))
+    store.release(oid)
+
+
+def test_jax_bfloat16_roundtrip(store):
+    """bf16 cannot ride dlpack (numpy can't export it) — the rebuild
+    falls back to jnp.asarray, preserving dtype."""
+    x = jnp.arange(2048, dtype=jnp.bfloat16)
+    oid = _put(store, x)
+    frames = store.get_frames(oid, pin_borrows=True)
+    y = serialization.deserialize(frames)
+    del frames
+    assert isinstance(y, jax.Array) and y.dtype == jnp.bfloat16
+    assert np.array_equal(np.asarray(y, dtype=np.float32),
+                          np.asarray(x, dtype=np.float32))
+    store.release(oid)
+
+
+def test_jax_array_inside_container(store):
+    """The reducer fires for arrays nested in ordinary values too."""
+    x = jnp.ones((64, 64), dtype=jnp.float32)
+    value = {"w": x, "step": 7}
+    sv = serialization.serialize(value)
+    assert len(sv.frames) >= 2
+    out = serialization.deserialize([bytes(f) for f in sv.frames])
+    assert out["step"] == 7 and isinstance(out["w"], jax.Array)
+    assert np.array_equal(np.asarray(out["w"]), np.asarray(x))
+
+
+def test_device_path_toggle_restores_pickle_path():
+    """serialization_device_zero_copy=False is the A/B control: jax
+    arrays go back through stock (in-band) pickling."""
+    cfg = get_config()
+    prev = cfg.serialization_device_zero_copy
+    cfg.serialization_device_zero_copy = False
+    try:
+        x = jnp.arange(1 << 16, dtype=jnp.float32)  # 256 KiB
+        sv = serialization.serialize(x)
+        # the old path: payload embedded in the pickle stream
+        assert len(sv.frames[0]) >= x.nbytes
+        y = serialization.deserialize([bytes(f) for f in sv.frames])
+        assert np.array_equal(np.asarray(y), np.asarray(x))
+    finally:
+        cfg.serialization_device_zero_copy = prev
+
+
+def test_noncontiguous_large_ndarray_goes_out_of_band():
+    """A strided view >= 1 MiB is normalized to one contiguous buffer and
+    shipped out-of-band instead of in-band via tobytes()."""
+    base = np.arange(4 << 20, dtype=np.uint8).reshape(2048, 2048)
+    strided = base[::2, ::2]  # non-contiguous, 1 MiB
+    assert not strided.flags.c_contiguous
+    sv = serialization.serialize(strided)
+    assert len(sv.frames) >= 2
+    assert sum(len(f) for f in sv.frames[1:]) == strided.nbytes
+    out = serialization.deserialize([bytes(f) for f in sv.frames])
+    assert np.array_equal(out, strided)
+
+
+# --------------------------------------------- zero-copy read + borrow pins
+
+
+def _oob_payload_offset(store, oid):
+    """Byte offset of the first out-of-band frame inside the sealed
+    entry's data region (frame 0 = pickle stream precedes it)."""
+    frames = store.get_frames(oid)
+    off = len(frames[0])
+    del frames
+    store.release(oid)
+    return off
+
+
+def test_ndarray_consumer_aliases_arena_memory(store):
+    """The no-intermediate-copy assertion: a large ndarray fetched from
+    the arena is a VIEW over the mapped segment — flipping a byte in the
+    sealed entry shows through the deserialized array."""
+    arr = np.arange(2 << 20, dtype=np.uint8)
+    oid = _put(store, arr)
+    off = _oob_payload_offset(store, oid)
+
+    frames = store.get_frames(oid, pin_borrows=True)
+    out = serialization.deserialize(frames)
+    del frames
+    assert isinstance(out, np.ndarray) and out.base is not None
+    # mutate the arena byte that backs out[0]
+    data, _meta = store.get(oid)
+    orig = data[off]
+    data[off] = (orig + 1) % 256
+    assert out[0] == (orig + 1) % 256, "consumer did not alias the arena"
+    data[off] = orig
+    del data, _meta
+    store.release(oid)  # the mutation probe's pin
+    store.release(oid)  # get_frames' read pin
+    assert out[0] == arr[0]
+
+
+def test_free_racing_live_borrow_defers_never_corrupts(store):
+    """THE safety property: deleting (free/spill path) an entry while a
+    zero-copy view is alive must pin, not recycle — the view's bytes
+    stay intact under allocation pressure, and the slot is reclaimed
+    only once the last view dies."""
+    arr = np.random.default_rng(1).integers(
+        0, 256, 8 << 20, dtype=np.uint8)
+    oid = _put(store, arr)
+    frames = store.get_frames(oid, pin_borrows=True)
+    out = serialization.deserialize(frames)
+    del frames
+    store.release(oid)  # drop the read pin; only the borrow pin remains
+    expected = out.copy()
+
+    # the free path races the live view: the delete must defer
+    assert store.delete(oid) is False
+    assert store.live_borrows(oid) > 0
+    # allocation pressure: churn puts through the arena — the deferred
+    # slot must never be handed out while the view is alive
+    for i in range(12):
+        tmp = ObjectID.from_random()
+        store.put_serialized(
+            tmp, [np.full(6 << 20, i, dtype=np.uint8)])
+        store.delete(tmp)
+    assert np.array_equal(out, expected), "borrowed view was corrupted"
+
+    used_before = store.bytes_in_use()
+    del out
+    gc.collect()
+    store.reap_borrows()  # dead-view processing is async (reaper thread)
+    # the deferred delete lands once the last view dies
+    assert not store.contains(oid)
+    assert store.bytes_in_use() < used_before
+    assert store.borrow_deferred_deletes >= 1
+
+
+def test_delete_without_live_borrow_is_immediate(store):
+    """The other direction of 'asserted both ways': with no live view
+    the delete reclaims the slot right away."""
+    arr = np.arange(1 << 20, dtype=np.uint8)
+    oid = _put(store, arr)
+    frames = store.get_frames(oid, pin_borrows=True)
+    copied = bytes(frames[1])  # materialize; keep NO aliasing object
+    del frames
+    gc.collect()  # wrapper views die...
+    store.reap_borrows()  # ...and the reaper releases the borrow pin
+    store.release(oid)  # read pin
+    assert store.delete(oid) is True
+    assert not store.contains(oid)
+    assert copied[:4] == bytes(arr[:4])
+
+
+def test_eviction_skips_borrowed_entry(store):
+    """LRU eviction under arena pressure must not reclaim an entry a
+    live zero-copy view still aliases."""
+    arr = np.arange(4 << 20, dtype=np.uint8)
+    oid = _put(store, arr)
+    frames = store.get_frames(oid, pin_borrows=True)
+    out = serialization.deserialize(frames)
+    del frames
+    store.release(oid)
+    evicted = store.evict(ARENA)  # ask for everything
+    assert oid not in evicted
+    assert np.array_equal(out, arr)
+    del out
+    gc.collect()
+    store.reap_borrows()
+
+
+def test_jax_array_from_arena_survives_entry_delete(store):
+    """A jax.Array consumer holds either an aliasing import (borrow-
+    pinned) or its own copy — deleting the entry mid-life must not
+    change its contents either way."""
+    x = jnp.asarray(np.random.default_rng(2).normal(size=1 << 20)
+                    .astype(np.float32))
+    oid = _put(store, x)
+    frames = store.get_frames(oid, pin_borrows=True)
+    y = serialization.deserialize(frames)
+    del frames
+    store.release(oid)
+    expected = np.asarray(y).copy()
+    store.delete(oid)  # may defer (aliasing import) or land (copied)
+    for i in range(6):
+        tmp = ObjectID.from_random()
+        store.put_serialized(
+            tmp, [np.full(8 << 20, i, dtype=np.uint8)])
+        store.delete(tmp)
+    assert np.array_equal(np.asarray(y), expected)
+
+
+# ----------------------------------------------------------- wire shapes
+
+
+def test_frames_materialize_for_wire_embedding():
+    """SerializedValue frames from the device path must stay bytes()-able
+    (task args embed frames in pickled messages)."""
+    x = jnp.arange(4096, dtype=jnp.int32)
+    sv = serialization.serialize(x)
+    blobs = [bytes(f) for f in sv.frames]
+    assert sum(len(b) for b in blobs) == sv.total_bytes
+    y = serialization.deserialize(blobs)
+    assert np.array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_readonly_wire_frames_deserialize():
+    """Frames that arrive as immutable bytes (AGENT_OBJ_GET, inline args)
+    rebuild fine — the dlpack zero-copy import falls back to a copy for
+    readonly buffers."""
+    x = jnp.ones((128, 128), dtype=jnp.float32)
+    sv = serialization.serialize(x)
+    stream = pickle.dumps([bytes(f) for f in sv.frames])
+    y = serialization.deserialize(pickle.loads(stream))
+    assert isinstance(y, jax.Array)
+    assert np.array_equal(np.asarray(y), np.asarray(x))

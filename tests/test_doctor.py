@@ -70,6 +70,29 @@ def test_doctor_warns_on_event_drops(ray_start):
     assert any("task_events_dropped" in w for w in warns), warns
 
 
+def test_doctor_warns_on_prefetch_waste(ray_start):
+    """A mostly-wasted prefetch window (task cancel/retry churn or
+    misconfigured caps) must surface as a doctor warning; the check is
+    windowed between doctor calls, so a long-past burst of waste does
+    not alarm forever."""
+    from ray_tpu import dashboard as dash_mod
+    from ray_tpu.core.api import _head
+
+    dash_mod.doctor_warnings()  # snapshot the window baseline
+    _head.prefetch_issued += 40
+    _head.prefetch_wasted += 30
+    warns = dash_mod.doctor_warnings()
+    assert any("prefetch_wasted" in w for w in warns), warns
+    # next window: counters unchanged -> no stale re-warning
+    assert not any("prefetch_wasted" in w
+                   for w in dash_mod.doctor_warnings())
+    # healthy ratio in a new window -> quiet
+    _head.prefetch_issued += 100
+    _head.prefetch_wasted += 2
+    assert not any("prefetch_wasted" in w
+                   for w in dash_mod.doctor_warnings())
+
+
 def test_summary_tasks_phase_percentiles_smoke(ray_start):
     """Tier-1 CI smoke: after a short 2-node workload,
     /api/summary/tasks reports per-phase p50/p95/p99 and /metrics

@@ -318,6 +318,273 @@ def test_pull_missing_everywhere_fails(xfer):
     assert not dst.contains(oid)
 
 
+# ------------------------------------------ speculative arg prefetch (r13)
+#
+# At lease grant (and again at driver dispatch via PREFETCH_HINT) the
+# head already holds the task's deduped by-ref arg ids — when the chosen
+# node's directory entry shows missing args it fires a prefetch-flagged
+# PULL_OBJECT at that node's agent so the pull overlaps the lease reply,
+# driver dispatch and worker wakeup (the reference PullManager's
+# prefetch role). The worker's get() then JOINS the in-flight pull via
+# the puller's _pending leadership machinery.
+
+
+class _AgentConn(_FakeConn):
+    """Fake remote-agent channel: records one-way sends, answers the
+    clock-probe PING."""
+
+    peer = "fake-agent"
+    closed = False
+    on_close = None
+
+    def __init__(self):
+        super().__init__()
+        self.sent = []
+
+    def send(self, mt, *fields, request_id=0):
+        self.sent.append((mt, fields))
+
+    def call(self, mt, *fields, timeout=None):
+        return (True, time.monotonic(), time.time())
+
+    def close(self):
+        self.closed = True
+
+
+def _add_remote(head, ip, num_cpus=2):
+    from ray_tpu.core.resources import detect_node_resources
+
+    conn = _AgentConn()
+    nr = detect_node_resources(num_cpus=num_cpus, num_tpus=0)
+    idx = head.register_remote_node(conn, nr, f"st_{ip}", ip, "/tmp/x",
+                                    f"tcp:{ip}:7000")
+    return idx, conn
+
+
+def _idle_worker(head, idx, cls, wid="pfw"):
+    from ray_tpu.core.head import WorkerInfo
+
+    with head._lock:
+        node = head.nodes[idx]
+        node.workers[wid] = WorkerInfo(
+            worker_id=wid, node_idx=idx, listen_addr=f"unix:/{wid}",
+            state="idle", sched_class=cls)
+        node.idle_by_class.setdefault(cls, []).append(wid)
+
+
+def _grant_with_args(head, dst_idx, arg_bins, cls=("pf",)):
+    """Queue one lease pinned to ``dst_idx`` carrying ``arg_bins`` and
+    run a dispatch pass; returns the driver conn (grant in .replies)."""
+    from ray_tpu.core.serialization import dumps
+
+    drv = _FakeConn()
+    strategy = NodeAffinitySchedulingStrategy(dst_idx)
+    head._queue_lease(drv, 1, cls, {"CPU": 1}, "job", dumps(strategy),
+                      list(arg_bins))
+    head._try_fulfill_pending()
+    return drv
+
+
+def _pulls_sent(conn):
+    return [f for mt, f in conn.sent if mt == P.PULL_OBJECT]
+
+
+def test_prefetch_issued_on_grant_to_non_holder(head):
+    idx_a, _conn_a = _add_remote(head, "10.7.0.1")
+    idx_b, conn_b = _add_remote(head, "10.7.0.2")
+    cls = ("pf1",)
+    _idle_worker(head, idx_b, cls)
+    oid = ObjectID.from_random()
+    head._h_obj_location_add(_FakeConn(), 0, oid.binary(), idx_a, 4 << 20)
+
+    drv = _grant_with_args(head, idx_b, [oid.binary()], cls)
+    assert drv.replies and drv.replies[-1][0] is True  # lease granted
+    pulls = _pulls_sent(conn_b)
+    assert len(pulls) == 1
+    oid_bin, addrs, size, _ms, _relays, prefetch = pulls[0][:6]
+    assert oid_bin == oid.binary() and size == 4 << 20 and prefetch
+    assert f"tcp:10.7.0.1:7000" in addrs
+    assert head.prefetch_issued == 1
+    assert (oid.binary(), idx_b) in head._prefetches
+    # the cooperative planner registered the pull: source charged,
+    # destination listed in-progress (it can relay for later pullers)
+    loc = head.objects.get(oid)
+    assert loc.serving and idx_b in loc.inprog
+
+    # completion releases the source charge and marks the entry done
+    head._h_prefetch_result(conn_b, 0, oid.binary(), idx_b, True)
+    assert head.prefetch_completed == 1
+    assert not head.objects.get(oid).serving
+    assert head._prefetches[(oid.binary(), idx_b)].state == "done"
+
+    # normal lease return pops the satisfied entry — nothing was wasted
+    lease_id, wid = drv.replies[-1][3], drv.replies[-1][1]
+    head._h_return_worker(drv, 0, lease_id, wid)
+    assert head.prefetch_wasted == 0
+    assert (oid.binary(), idx_b) not in head._prefetches
+
+
+def test_prefetch_skipped_when_node_already_holds(head):
+    idx_b, conn_b = _add_remote(head, "10.7.1.2")
+    cls = ("pf2",)
+    _idle_worker(head, idx_b, cls)
+    oid = ObjectID.from_random()
+    head._h_obj_location_add(_FakeConn(), 0, oid.binary(), idx_b, 4 << 20)
+
+    drv = _grant_with_args(head, idx_b, [oid.binary()], cls)
+    assert drv.replies and drv.replies[-1][0] is True
+    assert not _pulls_sent(conn_b)
+    assert head.prefetch_issued == 0
+
+
+def test_prefetch_caps_respected(head):
+    """arg_prefetch_max_inflight / _max_bytes bound what one dispatch
+    pass may fire at a node."""
+    cfg = get_config()
+    prev = (cfg.arg_prefetch_max_inflight, cfg.arg_prefetch_max_bytes)
+    idx_a, _ = _add_remote(head, "10.7.2.1")
+    idx_b, conn_b = _add_remote(head, "10.7.2.2")
+    cls = ("pf3",)
+    _idle_worker(head, idx_b, cls)
+    oids = [ObjectID.from_random() for _ in range(3)]
+    for o in oids:
+        head._h_obj_location_add(_FakeConn(), 0, o.binary(), idx_a,
+                                 4 << 20)
+    try:
+        cfg.arg_prefetch_max_inflight = 2
+        cfg.arg_prefetch_max_bytes = 5 << 20  # fits ONE 4 MiB arg
+        _grant_with_args(head, idx_b, [o.binary() for o in oids], cls)
+        assert len(_pulls_sent(conn_b)) == 1  # byte cap bound it
+        assert head.prefetch_issued == 1
+
+        cfg.arg_prefetch_max_bytes = 1 << 30
+        # inflight cap (2): one already in flight, so ONE more fires
+        lease2 = ("pf3b",)
+        _idle_worker(head, idx_b, lease2, wid="pfw2")
+        _grant_with_args(head, idx_b,
+                         [o.binary() for o in oids], lease2)
+        assert len(_pulls_sent(conn_b)) == 2
+        assert head.prefetch_issued == 2
+    finally:
+        (cfg.arg_prefetch_max_inflight,
+         cfg.arg_prefetch_max_bytes) = prev
+
+
+def test_cancelled_lease_prefetch_aborted_and_wasted(head):
+    """A lease torn down while its prefetch is still in flight (task
+    cancelled / retried elsewhere / driver died) aborts the pull through
+    the r9 abort path and counts it wasted."""
+    idx_a, _ = _add_remote(head, "10.7.3.1")
+    idx_b, conn_b = _add_remote(head, "10.7.3.2")
+    cls = ("pf4",)
+    _idle_worker(head, idx_b, cls)
+    oid = ObjectID.from_random()
+    head._h_obj_location_add(_FakeConn(), 0, oid.binary(), idx_a, 4 << 20)
+
+    drv = _grant_with_args(head, idx_b, [oid.binary()], cls)
+    assert head.prefetch_issued == 1
+    lease_id, wid = drv.replies[-1][3], drv.replies[-1][1]
+    head._h_return_worker(drv, 0, lease_id, wid)  # pull still in flight
+    assert head.prefetch_wasted == 1
+    aborts = [f for mt, f in conn_b.sent if mt == P.PULL_ABORT]
+    assert aborts == [(oid.binary(),)]
+    # the agent's (failed) result release: charges freed, entry gone
+    head._h_prefetch_result(conn_b, 0, oid.binary(), idx_b, False)
+    assert not head.objects.get(oid).serving
+    assert (oid.binary(), idx_b) not in head._prefetches
+
+
+def test_prefetch_hint_fires_for_leased_worker(head):
+    """The driver's dispatch-time PREFETCH_HINT (leases are long-lived:
+    grant-time args cover only the first task) issues for the lease's
+    node with the same caps/dedupe."""
+    idx_a, _ = _add_remote(head, "10.7.4.1")
+    idx_b, conn_b = _add_remote(head, "10.7.4.2")
+    cls = ("pf5",)
+    _idle_worker(head, idx_b, cls)
+    drv = _grant_with_args(head, idx_b, [], cls)  # no grant-time args
+    assert not _pulls_sent(conn_b)
+    lease_id = drv.replies[-1][3]
+    oid = ObjectID.from_random()
+    head._h_obj_location_add(_FakeConn(), 0, oid.binary(), idx_a, 4 << 20)
+    head._h_prefetch_hint(drv, 0, lease_id, [oid.binary()])
+    assert len(_pulls_sent(conn_b)) == 1
+    assert head.prefetch_issued == 1
+    # duplicate hint dedupes against the in-flight entry
+    head._h_prefetch_hint(drv, 0, lease_id, [oid.binary()])
+    assert len(_pulls_sent(conn_b)) == 1
+    # unknown lease: ignored
+    head._h_prefetch_hint(drv, 0, "no_such_lease", [oid.binary()])
+    assert head.prefetch_issued == 1
+
+
+def test_prefetch_pull_joined_by_demand_get(xfer):
+    """The worker-side contract: a demand pull for an object whose
+    prefetch is in flight JOINS it via _pending leadership — one
+    transfer serves both, and a joined prefetch is no longer abortable."""
+    make_source, dst, puller = xfer
+    s1, srv1 = make_source()
+    oid, payload = ObjectID.from_random(), _payload(4 * 1024 * 1024,
+                                                   seed=11)
+    _seed([s1], oid, payload)
+    srv1.throttle_s = 0.05  # ~4 chunks: the demand get lands mid-pull
+
+    done = {}
+
+    def prefetch():
+        done["ok"] = puller.pull(oid, [srv1.addr], timeout=60,
+                                 size_hint=len(payload), prefetch=True)
+
+    t = threading.Thread(target=prefetch)
+    t.start()
+    deadline = time.monotonic() + 30
+    while puller.bytes_by_source.get(srv1.addr, 0) == 0 and \
+            time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert puller.pull(oid, [srv1.addr], timeout=60,
+                       size_hint=len(payload))  # joins, does not restart
+    t.join(30)
+    assert done.get("ok") is True
+    assert puller.prefetch_joins == 1
+    assert puller.pulls_completed == 1  # ONE transfer served both
+    assert _fetch_bytes(dst, oid) == payload
+    assert puller.abort(oid) is False  # gone (and was joined anyway)
+
+
+def test_prefetch_abort_cleans_unsealed_entry(xfer):
+    """PULL_ABORT mid-prefetch: the leader wakes, the created-but-
+    unsealed arena entry is deleted (r9 abort path), and a later demand
+    pull starts clean."""
+    make_source, dst, puller = xfer
+    s1, srv1 = make_source()
+    oid, payload = ObjectID.from_random(), _payload(4 * 1024 * 1024,
+                                                   seed=12)
+    _seed([s1], oid, payload)
+    srv1.throttle_s = 0.3  # slow enough to abort mid-flight
+
+    done = {}
+
+    def prefetch():
+        done["ok"] = puller.pull(oid, [srv1.addr], timeout=60,
+                                 size_hint=len(payload), prefetch=True)
+
+    t = threading.Thread(target=prefetch)
+    t.start()
+    deadline = time.monotonic() + 30
+    while puller.bytes_by_source.get(srv1.addr, 0) == 0 and \
+            time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert puller.abort(oid) is True
+    t.join(30)
+    assert done.get("ok") is False
+    assert not dst.contains(oid)
+    # a demand pull (non-prefetch) is NOT abortable
+    srv1.throttle_s = 0.0
+    assert puller.pull(oid, [srv1.addr], timeout=60,
+                       size_hint=len(payload))
+    assert _fetch_bytes(dst, oid) == payload
+
+
 # ------------------------------------------------- cluster integration
 
 
@@ -409,6 +676,52 @@ def test_locality_falls_back_when_holder_infeasible(tcp_cluster):
 
     assert ray_tpu.get(big.remote(ref), timeout=120) == 0  # hybrid fallback
     assert head.locality_misses > misses0
+
+
+def test_prefetch_overlaps_dispatch_real_cluster(tcp_cluster):
+    """End-to-end r13: a task pinned to a NON-holder node has its by-ref
+    arg speculatively pulled (grant-time args + dispatch-time hint both
+    route through the same machinery), the task sees correct bytes, and
+    nothing reads as wasted — the agent's PREFETCH_RESULT released the
+    planner charges."""
+    import ray_tpu.core.api as core_api
+
+    cluster, handles = tcp_cluster
+    r1 = cluster.add_remote_node(num_cpus=1)
+    r2 = cluster.add_remote_node(num_cpus=1)
+    handles.extend([r1, r2])
+    head = core_api._head
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        r1.node_idx))
+    def produce():
+        return np.arange(400_000, dtype=np.float64)  # ~3.2 MB
+
+    ref = produce.remote()
+    ray_tpu.wait([ref], timeout=120)
+    _wait_holders(head, ref.id, 1)
+    issued0, wasted0 = head.prefetch_issued, head.prefetch_wasted
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        r2.node_idx))
+    def consume(arr):
+        return float(arr[-1])
+
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == 399_999.0
+    assert head.prefetch_issued > issued0
+    assert head.prefetch_wasted == wasted0  # nothing was stale
+    # the speculative copy landed on the executing node: directory
+    # lists r2 as a holder (OBJ_LOCATION_ADD from its pull)
+    _wait_holders(head, ref.id, 2)
+    # charges released (PREFETCH_RESULT or demand-pull finish): no
+    # source stays load-accounted forever
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        loc = head.objects.get(ref.id)
+        if loc is not None and not loc.serving:
+            break
+        time.sleep(0.05)
+    assert not head.objects.get(ref.id).serving
 
 
 def test_cross_host_pull_striped_across_holders(tcp_cluster):

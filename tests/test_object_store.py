@@ -112,3 +112,51 @@ def test_metadata_roundtrip(store):
     assert bytes(data) == b"abc" and bytes(meta) == b"mm"
     del data, meta
     store.release(oid)
+
+
+# ------------------------------------------- zero-copy reads (r13)
+
+
+def test_pinned_frames_roundtrip_zero_copy(store):
+    """get_frames(pin_borrows=True): the out-of-band frame aliases the
+    arena (no copy), the deserialized array reads through it, and the
+    borrow ledger tracks the live view."""
+    import gc
+
+    oid = ObjectID.from_random()
+    arr = np.arange(300_000, dtype=np.int32)
+    store.put_serialized(oid, serialization.serialize(arr).frames)
+
+    frames = store.get_frames(oid, pin_borrows=True)
+    out = serialization.deserialize(frames)
+    del frames
+    assert np.array_equal(out, arr)
+    assert out.base is not None  # a view, not an owned copy
+    assert store.live_borrows(oid) > 0
+    store.release(oid)  # read pin; the borrow pin stays with `out`
+    del out
+    gc.collect()
+    store.reap_borrows()  # dead-view processing is async (reaper thread)
+    assert store.live_borrows(oid) == 0
+    assert store.delete(oid)
+
+
+def test_delete_defers_until_borrowed_view_dies(store):
+    """The store-level pin-while-borrowed contract: delete() with a
+    live zero-copy view returns False and runs when the view dies."""
+    import gc
+
+    oid = ObjectID.from_random()
+    arr = np.arange(500_000, dtype=np.float64)
+    store.put_serialized(oid, serialization.serialize(arr).frames)
+    frames = store.get_frames(oid, pin_borrows=True)
+    out = serialization.deserialize(frames)
+    del frames
+    store.release(oid)
+
+    assert store.delete(oid) is False  # deferred, not recycled
+    assert np.array_equal(out, arr)   # bytes intact under the view
+    del out
+    gc.collect()
+    store.reap_borrows()  # dead-view processing is async (reaper thread)
+    assert not store.contains(oid)    # deferred delete landed
