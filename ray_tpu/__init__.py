@@ -39,6 +39,7 @@ from ray_tpu.core.api import (
     remove_placement_group,
     shutdown,
     wait,
+    warm_object,
 )
 from ray_tpu.core.exceptions import (
     ActorDiedError,
@@ -60,7 +61,7 @@ __all__ = [
     "remove_placement_group", "placement_group_table",
     "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
     "nodes", "cluster_resources", "available_resources", "timeline",
-    "object_locations",
+    "object_locations", "warm_object",
     "RayTaskError", "ActorDiedError", "ActorUnavailableError",
     "GetTimeoutError", "ObjectLostError", "TaskCancelledError",
     "WorkerCrashedError",

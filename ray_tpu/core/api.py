@@ -586,6 +586,42 @@ def object_locations(ref: ObjectRef) -> dict:
             "spilled": spilled}
 
 
+def warm_object(ref: ObjectRef, node_idx: int = -1, *,
+                wait: bool = False) -> int:
+    """Warm a plasma-resident object onto node(s) before any consumer
+    task/actor is placed (r14; the proactive face of the reference
+    PullManager's prefetch role). Fires the head's OBJECT_WARM: every
+    targeted node missing the object gets a prefetch-flagged pull
+    through the broadcast-aware planner — concurrent warms of one
+    object form the r9 cooperative relay tree, and a later consumer's
+    get() joins the in-flight pull instead of starting cold. The serve
+    controller uses this to ship deployment weights at scale-up
+    decision time, before the new replicas even exist.
+
+    ``node_idx`` -1 targets every alive remote node. Fire-and-forget by
+    default; ``wait=True`` blocks for the head's ack and returns how
+    many pulls were issued (0 = every target already holds it, or
+    prefetching is disabled/capped)."""
+    from . import protocol as P
+
+    ctx = get_context()
+    if wait:
+        (issued,) = ctx.head.call(P.OBJECT_WARM, ref.id.binary(),
+                                  int(node_idx), timeout=30)
+        return int(issued)
+    # Never block on a head outage: a ReconnectingConnection PARKS
+    # writes for the reconnect window, and fire-and-forget callers (the
+    # serve controller decides scale-ups under its reconcile lock) must
+    # not stall on speculation. Skipping just loses the warm-up.
+    if not ctx.head.is_attached():
+        return 0
+    try:
+        ctx.head.send(P.OBJECT_WARM, ref.id.binary(), int(node_idx))
+    except P.ConnectionLost:
+        pass  # speculation only: consumers still demand-pull
+    return 0
+
+
 def cluster_resources() -> dict:
     total: dict = {}
     for n in nodes():

@@ -153,6 +153,71 @@ class Config:
     # waste-ratio warning or as joins instead of warm hits, not as
     # arena pressure).
     arg_prefetch_max_bytes: int = 256 * 1024 * 1024
+    # Dispatch-time PREFETCH_HINT dedupe window (r14): the driver
+    # submitter remembers, per leased worker, which by-ref arg ids it
+    # hinted in the last this-many seconds and strips them from later
+    # hints — an actor-task hot loop that passes the same refs on every
+    # call (the serve-handle weights/payload pattern) sends ONE hint per
+    # (lease, arg) per window instead of one per pushed batch. The head
+    # keeps its own dedupe, so this only saves wire frames + head-loop
+    # wakeups; <= 0 restores the hint-per-batch behavior.
+    prefetch_hint_dedupe_ttl_s: float = 5.0
+
+    # --- serve at scale (r14) ---
+    # How long a ``slow_node`` detector flag stays routable-around: the
+    # head marks the node slow in its `nodes` state rows for this long
+    # after each detection (refreshed while the skew persists), and
+    # serve routers deprioritize replicas on flagged nodes (power-of-
+    # two-choices falls back to them only when every clean replica is
+    # saturated). Longer than the detector's 30s per-(node,phase) event
+    # rate limit so a persistently slow host stays flagged between
+    # sweeps; <= 0 disables routing flags entirely (events still fire).
+    slow_node_route_ttl_s: float = 60.0
+    # Serve ingress zero-copy threshold: a handle.remote() positional /
+    # keyword arg that is bytes / bytearray / ndarray / jax.Array of at
+    # least this many bytes is put() into the object store and passed BY
+    # REFERENCE, so the payload rides the r8 vectored zero-copy wire
+    # path + r13 arena-backed typed reducer end-to-end (driver arena ->
+    # replica arena, no intermediate pickle copies) and the dispatch-
+    # time PREFETCH_HINT overlaps the replica's fetch with dispatch.
+    # Small args stay inline (a put + directory round-trip costs more
+    # than it saves). The default is deliberately high: inline args
+    # already ride the r8 zero-copy wire one hop, so by-ref only wins
+    # once the payload is large enough to amortize the extra arena hop
+    # and per-object control traffic — the ingress A/B in
+    # SERVE_BENCH_r14.json measured by-ref LOSING on loopback below
+    # ~16 MiB (0.34x rps at 2 MiB, 0.87x at 16 MiB). Lower it (e.g.
+    # 512 KiB) when replicas sit behind a paced/real network link or
+    # when the same payload fans out to many replicas (broadcast +
+    # prefetch regimes, where by-ref wins). <= 0 disables the by-ref
+    # conversion (the bench A/B control).
+    serve_request_by_ref_min_bytes: int = 16 * 1024 * 1024
+    # Serve deployment weights-by-ref threshold: an init arg of
+    # ``Deployment.bind(...)`` that is an ndarray / jax.Array / bytes
+    # of at least this many bytes — applied PER ARRAY, including
+    # elements found inside (nested) list/tuple/dict containers; a
+    # container of small shards each below the threshold ships inline
+    # even if the container total exceeds it — is put() into the object
+    # store ONCE at serve.run() time and
+    # replaced by a reference in the replica-spec payload — every
+    # replica fetches it through the object plane (cooperative
+    # pipelined broadcast under concurrent scale-up: near-constant
+    # cold-start in fleet size, root egress ~2xS) instead of unpickling
+    # a private copy shipped inside CREATE_ACTOR args. The controller
+    # also pre-warms these refs onto nodes at scale-up decision time
+    # (OBJECT_WARM). <= 0 disables the conversion; explicit ObjectRef
+    # init args are always resolved replica-side regardless.
+    serve_weights_by_ref_min_bytes: int = 4 * 1024 * 1024
+    # doctor_warnings(): flag a serve deployment whose autoscaler
+    # reversed direction (up->down or down->up) more than this many
+    # times inside the flap window (60s) — a flapping policy burns
+    # cold-starts and kills warm replicas; raise the hysteresis
+    # windows/cooldowns instead of living with it.
+    serve_flap_warn_reversals: int = 3
+    # doctor_warnings(): flag a deployment whose replica cold-start p95
+    # exceeds this bound — weights are not riding the broadcast path
+    # (missing by-ref init), or scale-ups are queueing behind placement.
+    serve_cold_start_p95_warn_s: float = 30.0
 
     # --- scheduling ---
     # Hybrid scheduling policy: prefer local node until its utilization

@@ -60,7 +60,7 @@ def set_context(ctx: Optional["CoreContext"]):
 
 class _LeasedWorker:
     __slots__ = ("worker_id", "addr", "lease_id", "conn", "inflight",
-                 "idle_since", "tpu_ids")
+                 "idle_since", "tpu_ids", "hinted")
 
     def __init__(self, worker_id, addr, lease_id, conn, tpu_ids=None):
         self.worker_id = worker_id
@@ -70,6 +70,32 @@ class _LeasedWorker:
         self.inflight: Dict[TaskID, TaskSpec] = {}
         self.idle_since = time.monotonic()
         self.tpu_ids = tpu_ids
+        self.hinted = None  # recently PREFETCH_HINTed arg ids (r14 dedupe)
+
+
+_HINT_CACHE_MAX = 512
+
+
+def _filter_hint_ids(hinted: dict, ids, now: float, ttl: float) -> list:
+    """PREFETCH_HINT dedupe filter (r14): drop ids hinted for this
+    lease/actor within ``ttl`` seconds, stamp the survivors, and keep
+    the per-holder cache bounded (expired entries evicted first, then
+    oldest-stamped — insertion order tracks stamp order because
+    re-stamps delete+reinsert)."""
+    fresh = []
+    for ab in ids:
+        ts = hinted.get(ab)
+        if ts is not None and now - ts < ttl:
+            continue
+        hinted.pop(ab, None)
+        hinted[ab] = now
+        fresh.append(ab)
+    if len(hinted) > _HINT_CACHE_MAX:
+        for k in [k for k, ts in hinted.items() if now - ts >= ttl]:
+            del hinted[k]
+        while len(hinted) > _HINT_CACHE_MAX:
+            del hinted[next(iter(hinted))]
+    return fresh
 
 
 class _ClassState:
@@ -84,7 +110,8 @@ class _ClassState:
 
 class _ActorState:
     __slots__ = ("actor_id", "state", "addr", "conn", "queue", "inflight",
-                 "seqno", "lock", "resolving", "death_cause", "connecting")
+                 "seqno", "lock", "resolving", "death_cause", "connecting",
+                 "hinted")
 
     def __init__(self, actor_id):
         self.connecting = False
@@ -98,6 +125,7 @@ class _ActorState:
         self.lock = threading.Lock()
         self.resolving = False
         self.death_cause = ""
+        self.hinted = None  # recently PREFETCH_HINTed arg ids (r14 dedupe)
 
 
 class _InflightTask:
@@ -247,6 +275,11 @@ class CoreContext:
         # borrowed-ref owners, for routing reconstruction requests
         self._known_owners: Dict[ObjectID, str] = {}
         self._dep_unready: set = set()  # actor tasks awaiting arg resolution
+        # PREFETCH_HINT accounting (r14): frames actually sent vs arg
+        # ids suppressed by the per-lease/per-actor dedupe window
+        self.prefetch_hints_sent = 0
+        self.prefetch_hints_suppressed = 0
+        self._hint_lock = threading.Lock()
         self._sub_lock = threading.RLock()
         self._submit_event = threading.Event()
         self._submitter = threading.Thread(target=self._submitter_loop,
@@ -1053,7 +1086,7 @@ class CoreContext:
                 worker.idle_since = time.monotonic()
             if not batch:
                 continue
-            self._send_prefetch_hint(worker, batch)
+            self._send_prefetch_hint(worker, batch, worker.lease_id)
             try:
                 if len(batch) == 1:
                     worker.conn.send(P.PUSH_TASK, batch[0], 0)
@@ -1072,15 +1105,30 @@ class CoreContext:
             w.conn.on_close = None
             w.conn.close()
 
-    def _send_prefetch_hint(self, worker, batch) -> None:
+    def _send_prefetch_hint(self, holder, batch, lease_key: str) -> None:
         """Dispatch-time speculative prefetch (r13): name the pushed
-        batch's by-ref args for the lease's node so the head can start
-        any missing pulls while the batch is still in flight to the
-        worker — leases are long-lived, so the grant-time hint covers
-        only the first task. One one-way frame per batch-with-refs
-        (coalesced by the wire layer); tasks without by-ref args (the
-        common case at high rates) pay nothing."""
-        if not get_config().arg_prefetch_enabled:
+        batch's by-ref args for the executing node so the head can
+        start any missing pulls while the batch is still in flight to
+        the worker — leases are long-lived, so the grant-time hint
+        covers only the first task. One one-way frame per
+        batch-with-refs (coalesced by the wire layer); tasks without
+        by-ref args (the common case at high rates) pay nothing.
+
+        r14: ``holder`` is whichever object pins the destination — a
+        ``_LeasedWorker`` (``lease_key`` = its lease id) or an
+        ``_ActorState`` (``lease_key`` = ``actor:<hex>``, resolved to
+        the actor's node head-side) — so actor-task hot loops (the
+        serve-handle pattern) get dispatch-time prefetch too. Hints
+        are DEDUPED per holder across consecutive batches: re-passing
+        the same refs on every call (handle payload/weights args)
+        would otherwise re-name the same ids to the head once per
+        pushed batch, and the head's own dedupe only saves the pull,
+        not the frame or the IO-loop wakeup. Each holder remembers the
+        arg ids it hinted within ``prefetch_hint_dedupe_ttl_s``; only
+        novel (or expired) ids ship. Suppressions are counted in
+        ``self.prefetch_hints_suppressed``."""
+        cfg = get_config()
+        if not cfg.arg_prefetch_enabled:
             return
         # NEVER block dispatch on the head channel: during a head
         # outage a ReconnectingConnection PARKS writes for the whole
@@ -1088,16 +1136,31 @@ class CoreContext:
         # right before pushing tasks to healthy leased workers — a
         # parked hint would stall all dispatch for the outage, undoing
         # the r12 availability. Speculation just skips the window.
-        attached = getattr(self.head, "_attached", None)
-        if attached is not None and not attached.is_set():
+        if not self.head.is_attached():
             return
         ids = list(dict.fromkeys(
             enc[1] for spec in batch for enc in spec.args
             if enc[0] == ARG_REF))[:64]
         if not ids:
             return
+        if cfg.prefetch_hint_dedupe_ttl_s > 0:
+            # _hint_lock: concurrent drains of the same holder (proxy
+            # thread pool + resolver ready-callbacks) would otherwise
+            # race the dict eviction in _filter_hint_ids.
+            with self._hint_lock:
+                hinted = holder.hinted
+                if hinted is None:
+                    hinted = holder.hinted = {}
+                ids, n_in = _filter_hint_ids(
+                    hinted, ids, time.monotonic(),
+                    cfg.prefetch_hint_dedupe_ttl_s), len(ids)
+                self.prefetch_hints_suppressed += n_in - len(ids)
+            if not ids:
+                return
+        with self._hint_lock:
+            self.prefetch_hints_sent += 1
         try:
-            self.head.send(P.PREFETCH_HINT, worker.lease_id, ids)
+            self.head.send(P.PREFETCH_HINT, lease_key, ids)
         except P.ConnectionLost:
             pass  # speculation only: the demand path still works
 
@@ -1541,6 +1604,13 @@ class CoreContext:
                                        task_events.SUBMITTED_TO_WORKER)
             except P.ConnectionLost:
                 pass  # conn.on_close handles re-resolution
+        if to_send:
+            # dispatch-time prefetch for ACTOR tasks (r14): the head
+            # resolves the actor key to its worker's node. Outside
+            # st.lock — speculation must not extend the dispatch
+            # critical section, and ordering is irrelevant to it.
+            self._send_prefetch_hint(
+                st, to_send, "actor:" + st.actor_id.hex())
 
     def _resolve_actor(self, st: _ActorState):
         try:

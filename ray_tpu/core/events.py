@@ -282,6 +282,11 @@ def emit_cluster_event(severity: str, source: str, event_type: str,
         severity, source, event_type, message,
         node_idx=ctx.node_idx if node_idx is None else node_idx,
         entity_id=entity_id, extra=extra)
+    # never block the emitter on a head outage: a ReconnectingConnection
+    # parks writes for the whole reconnect window, and this is called
+    # from lock-held control paths (e.g. the serve reconcile thread)
+    if not ctx.head.is_attached():
+        return
     try:
         ctx.head.send(P.CLUSTER_EVENT, [ev], 0)
     except P.ConnectionLost:

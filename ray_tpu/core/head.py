@@ -170,6 +170,11 @@ class _PrefetchState:
 # as satisfied-by-prefetch before the record is dropped.
 _PREFETCH_SWEEP_S = 180.0
 _PREFETCH_DONE_TTL_S = 60.0
+# Reserved lease id for OBJECT_WARM prefetches (r14 serve cold-start):
+# not a real lease, so the lease-liveness gate is skipped and teardown
+# never aborts them — warm entries age out via the normal done-TTL /
+# sweep paths instead.
+_WARM_LEASE = "__warm__"
 
 
 # task.phase_ms / task.node_phase_ms bucket bounds (milliseconds): task
@@ -382,6 +387,19 @@ class Head:
         self.stragglers_flagged = 0
         self.slow_nodes_flagged = 0
         self._last_slow_node_event: Dict[tuple, float] = {}
+        # node idx -> monotonic deadline while the slow_node detector's
+        # skew flag is ROUTABLE-AROUND (r14): refreshed on every
+        # detection, surfaced as `slow` in the nodes state rows so
+        # serve routers can deprioritize the host's replicas. Written
+        # under _metrics_lock (the detector holds it); TTL'd reads are
+        # GIL-atomic dict gets.
+        self._slow_node_until: Dict[int, float] = {}
+        # (node, phase) -> cumulative bucket vector at the last detector
+        # sweep: the skew check judges the DELTA since then (recent
+        # behavior), not the lifetime histogram — a node's early stall
+        # would otherwise keep its cumulative p95 skewed, re-stamping
+        # the routing flag long after the host recovered.
+        self._node_phase_prev: Dict[tuple, list] = {}
         # Structured cluster event log (reference: the GCS event
         # aggregator behind `ray list cluster-events`): severity-tagged
         # records from head-side emitters and any process's
@@ -861,6 +879,9 @@ class Head:
             self.scheduler.remove_node(idx)
         with self._metrics_lock:
             self.node_telemetry.pop(idx, None)
+            self._slow_node_until.pop(idx, None)
+            for phase in ("dispatch", "arg_fetch"):
+                self._node_phase_prev.pop((idx, phase), None)
             # prune the node's telemetry gauges from the merged metric
             # table too — a dead host must not keep exporting
             # fresh-looking node_cpu_percent rows to scrapers forever
@@ -2506,8 +2527,13 @@ class Head:
             return 0
         with self._lock:
             node = self.nodes.get(node_idx)
+            # WARM / actor keys are not real leases: no liveness gate
+            # (warm entries age out via the sweep; a dead actor's
+            # entries do too — teardown never names these keys)
+            synthetic = lease_id == _WARM_LEASE or \
+                lease_id.startswith("actor:")
             if node is None or not node.alive or node.agent_conn is None \
-                    or lease_id not in self.leases:
+                    or (not synthetic and lease_id not in self.leases):
                 return 0
             conn = node.agent_conn
         issued = 0
@@ -2585,12 +2611,66 @@ class Head:
         """Driver dispatch-time prefetch (PREFETCH_HINT): leases are
         long-lived and serve many tasks, so grant-time args cover only
         the first — the submitter names each pushed batch's by-ref args
-        for the lease's node and the same caps/dedupe apply."""
+        for the lease's node and the same caps/dedupe apply. r14: keys
+        of the form ``actor:<hex>`` name an ACTOR's pushed batch (the
+        serve-handle hot loop); the head resolves the actor to its
+        worker's node here — the driver only knows the actor's socket
+        address, not its node."""
+        if isinstance(lease_id, str) and lease_id.startswith("actor:"):
+            node_idx = self._actor_node_idx(lease_id[len("actor:"):])
+            if node_idx is not None:
+                self._maybe_prefetch_args(lease_id, node_idx, arg_bins)
+            return
         with self._lock:
             lease = self.leases.get(lease_id)
         if lease is None:
             return  # lease already returned: nothing to speculate for
         self._maybe_prefetch_args(lease_id, lease[0], arg_bins)
+
+    def _actor_node_idx(self, actor_hex: str) -> Optional[int]:
+        """Node currently hosting an actor's worker (None when the
+        actor is dead/unknown/not yet placed)."""
+        try:
+            aid = ActorID(bytes.fromhex(actor_hex))
+        except ValueError:
+            return None
+        with self._lock:
+            actor = self.actors.get(aid)
+            if actor is None or actor.state != "ALIVE" or \
+                    not actor.worker_id:
+                return None
+            for node in self.nodes.values():
+                if actor.worker_id in node.workers:
+                    return node.idx
+        return None
+
+    def _h_object_warm(self, conn, rid, oid_bin, node_idx):
+        """OBJECT_WARM (r14): warm one object onto node(s) BEFORE any
+        consumer exists — the serve controller fires this at scale-up
+        decision time so deployment weights are landing (or landed)
+        when the new replicas' constructors ask. Rides the r13 prefetch
+        machinery under the reserved WARM lease: same per-node
+        inflight/byte caps and pacing queue, same PREFETCH_RESULT
+        charge accounting, same holder dedupe — and because each warm
+        pull registers as an in-progress location, N concurrent warms
+        of one object form the r9 cooperative broadcast tree
+        (root egress ~2xS, not NxS). node_idx -1 = every alive remote
+        node not already holding the object. Replies the number of
+        pulls issued when sent as a call."""
+        ab = bytes(oid_bin)
+        with self._lock:
+            if node_idx >= 0:
+                node = self.nodes.get(node_idx)
+                targets = [node_idx] if node is not None and node.alive \
+                    else []
+            else:
+                targets = [n.idx for n in self.nodes.values()
+                           if n.alive and n.agent_conn is not None]
+        issued = 0
+        for idx in targets:
+            issued += self._maybe_prefetch_args(_WARM_LEASE, idx, [ab])
+        if rid > 0:
+            conn.reply(rid, issued)
 
     def _h_prefetch_result(self, conn, rid, oid_bin, node_idx, ok):
         self._prefetch_finished(bytes(oid_bin), int(node_idx), bool(ok))
@@ -3220,27 +3300,47 @@ class Head:
             v[-2] += value_ms
             v[-1] += 1
 
-    def _task_phase_summary(self) -> Dict[str, dict]:
+    def _task_phase_summary(self, funcs=None,
+                            include_raw=False) -> Dict[str, dict]:
         """{func: {phase: {count, mean_ms, p50_ms, p95_ms, p99_ms}}}
-        from the folded phase histograms (takes the metrics lock)."""
+        from the folded phase histograms (takes the metrics lock).
+        ``funcs`` restricts the scan to those func names — the serve
+        controller's 1/s SLO-burn poll asks for exactly its replica
+        methods, so the reply stays a few rows no matter how many other
+        funcs the cluster has run (the summary never rides the per-
+        request hot path; it feeds scale decisions). ``include_raw``
+        (the phase_summary state query only) adds the raw cumulative
+        vectors — the dashboard/CLI task summary reuses this method and
+        must not ship ~35-element arrays per row it never reads."""
         out: Dict[str, dict] = {}
         with self._metrics_lock:
             rows = list(self.metrics.items())
         for key, row in rows:
             if key[0] != "task.phase_ms":
                 continue
+            if funcs is not None and row["tags"]["func"] not in funcs:
+                continue
             v, b = row["value"], row["boundaries"]
             n = v[-1]
             if n <= 0:
                 continue
-            out.setdefault(row["tags"]["func"], {})[
-                row["tags"]["phase"]] = {
+            entry = {
                 "count": n,
                 "mean_ms": v[-2] / n,
                 "p50_ms": _hist_quantile(b, v, 0.50),
                 "p95_ms": _hist_quantile(b, v, 0.95),
                 "p99_ms": _hist_quantile(b, v, 0.99),
             }
+            if include_raw:
+                # raw cumulative vector ([buckets..., overflow, sum_ms,
+                # count]) so pollers can delta successive snapshots
+                # into a WINDOWED quantile (the lifetime percentiles
+                # above stop moving once history dwarfs the recent
+                # past)
+                entry["buckets"] = list(v)
+                entry["boundaries"] = list(b)
+            out.setdefault(row["tags"]["func"], {})[
+                row["tags"]["phase"]] = entry
         return out
 
     def detect_stragglers(self):
@@ -3332,22 +3432,38 @@ class Head:
                 if key[0] != "task.node_phase_ms" or \
                         row["tags"].get("phase") != phase:
                     continue
-                if row["value"][-1] < cfg.straggler_min_samples:
-                    continue
                 try:
                     nidx = int(row["tags"]["node"])
                 except ValueError:
                     continue
+                # judge the delta since the last sweep, not the lifetime
+                # vector (see _node_phase_prev) — and advance the
+                # baseline for EVERY row so every node's window covers
+                # the same span regardless of gating below
+                cur = row["value"]
+                prev = self._node_phase_prev.get((nidx, phase))
+                self._node_phase_prev[(nidx, phase)] = list(cur)
+                delta = cur if prev is None or len(prev) != len(cur) \
+                    else [cur[i] - prev[i] for i in range(len(cur))]
+                if delta[-1] < cfg.straggler_min_samples:
+                    continue  # too few RECENT samples to judge
                 node = self.nodes.get(nidx)
                 if node is None or not node.alive:
                     continue  # stale histogram of a removed node
                 p95s[nidx] = _hist_quantile(row["boundaries"],
-                                            row["value"], 0.95)
+                                            delta, 0.95)
             if len(p95s) < 2:
                 continue
             med = statistics.median(p95s.values())
             for nidx, p95 in p95s.items():
                 if p95 > med * cfg.straggler_factor and p95 >= med + 5.0:
+                    # routing flag refreshes on EVERY detection (the
+                    # event below is rate-limited; the flag must not
+                    # lapse between throttled events while the skew
+                    # persists)
+                    if cfg.slow_node_route_ttl_s > 0:
+                        self._slow_node_until[nidx] = \
+                            now + cfg.slow_node_route_ttl_s
                     last = self._last_slow_node_event.get((nidx, phase),
                                                           -1e18)
                     if now - last < 30.0:
@@ -3409,6 +3525,15 @@ class Head:
         tables, timeline/metrics/event-ring locks for observability
         state, per-shard snapshots for the object directory) — a
         dashboard poll can no longer stall lease granting."""
+        if isinstance(kind, str) and kind.startswith("phase_summary"):
+            # "phase_summary" or "phase_summary:func1,func2" — the
+            # func-scoped per-phase percentile query the serve
+            # controller polls for SLO-burn autoscaling (r14)
+            _, _, spec = kind.partition(":")
+            funcs = frozenset(f for f in spec.split(",") if f) or None
+            conn.reply(rid, [self._task_phase_summary(
+                funcs, include_raw=True)])
+            return
         fn = self._STATE_KINDS.get(kind)
         if fn is None:
             conn.reply_error(rid, ValueError(f"unknown kind {kind!r}"))
@@ -3417,12 +3542,20 @@ class Head:
         conn.reply(rid, rows[:limit])
 
     def _sq_nodes(self, limit):
+        now = time.monotonic()
         with self._metrics_lock:
             telemetry = {i: dict(t) for i, t in self.node_telemetry.items()}
+            slow = {i for i, until in self._slow_node_until.items()
+                    if until > now}
         with self._lock:
             return [{
                 "node_idx": n.idx, "alive": n.alive,
                 "is_remote": n.is_remote, "node_ip": n.node_ip,
+                # live slow_node detector flag (r14): the node's
+                # dispatch/arg_fetch p95 skewed off the cluster median
+                # within the last slow_node_route_ttl_s — serve routers
+                # steer traffic away while it is set
+                "slow": n.idx in slow,
                 "resources_total": n.resources.total.to_dict(),
                 "resources_available": n.resources.available.to_dict(),
                 # last reporter-agent sample for this node (node.*
@@ -3919,6 +4052,7 @@ class Head:
         P.XLANG_CALL: _h_xlang_call,
         P.PREFETCH_RESULT: _h_prefetch_result,
         P.PREFETCH_HINT: _h_prefetch_hint,
+        P.OBJECT_WARM: _h_object_warm,
     }
 
     def _forward_to_worker(self, worker_id: str, mt: int, *fields):

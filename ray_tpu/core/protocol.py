@@ -195,6 +195,21 @@ PREFETCH_HINT = 78      # driver->head, one-way: (lease_id,
 #                         the same holder check / caps / dedupe and
 #                         fires prefetch-flagged PULL_OBJECTs while the
 #                         batch is still in flight to the worker.
+OBJECT_WARM = 79        # client->head: (oid_bin, node_idx) — warm an
+#                         object onto a node BEFORE any task/actor that
+#                         needs it is even placed (r14 serve cold-start:
+#                         the controller warms deployment weights at
+#                         scale-up decision time so replica construction
+#                         finds the bytes local or joins the in-flight
+#                         pull). node_idx = -1 warms every alive remote
+#                         node missing the object. Rides the r13
+#                         prefetch machinery (same caps / pacing /
+#                         dedupe / PREFETCH_RESULT accounting) under the
+#                         reserved WARM lease, and the pulls register as
+#                         in-progress locations, so N concurrent warms
+#                         form the r9 cooperative broadcast tree.
+#                         Replied (pull count issued) when sent as a
+#                         call; also valid one-way.
 OBJ_PULL_FAIL = 72      # server->puller: (oid_bin, offset) — the server
                         # cannot complete the requested range past
                         # `offset` (its own in-progress pull aborted, or
@@ -313,6 +328,15 @@ class Connection:
         self._ioloop: Optional["IOLoop"] = None
         self._on_message_cb = None  # set by IOLoop.add_connection
         sock.setblocking(True)
+
+    def is_attached(self) -> bool:
+        """True when a send would not park. Plain connections never park
+        (a dead socket raises ConnectionLost immediately);
+        ReconnectingConnection overrides this with its reattach gate.
+        Fire-and-forget senders that must NEVER block on a head outage
+        (speculative hints, warm requests, event emits) check this and
+        skip the send instead."""
+        return True
 
     # -- send side --
 
@@ -754,6 +778,9 @@ class ReconnectingConnection(Connection):
         self.reconnect_attempts = 0  # dial attempts (incl. failures)
         # identify ourselves so the head can dedupe retried requests
         self.send(CLIENT_HELLO, client_id, False)
+
+    def is_attached(self) -> bool:
+        return self._attached.is_set()
 
     def _reconnect_window_s(self) -> float:
         if self._timeout_s is not None:

@@ -116,12 +116,28 @@ class _Handler(BaseHTTPRequestHandler):
                     for dn, dep in info.get("deployments", {}).items():
                         running = dep.get("replica_states", {}) \
                             .get("RUNNING", 0)
+                        auto = dep.get("autoscaler") or {}
+                        cold = auto.get("cold_start") or {}
+                        last = auto.get("last_decision") or {}
                         rows.append({
                             "app": app, "deployment": dn,
                             "target_replicas": dep.get("target_replicas"),
                             "running_replicas": running,
                             "version": dep.get("version"),
-                            "status": dep.get("status")})
+                            "status": dep.get("status"),
+                            # autoscaler introspection (r14): scale
+                            # events must be debuggable from the row
+                            "autoscaling": auto.get("enabled", False),
+                            "desired_replicas": auto.get("desired"),
+                            "queue_depth": auto.get("queue_depth", 0),
+                            "last_decision":
+                                (f"{last.get('direction')} "
+                                 f"{last.get('from')}->{last.get('to')}: "
+                                 f"{last.get('reason')}")
+                                if last else "",
+                            "reversals_60s": auto.get("reversals_60s", 0),
+                            "cold_start_p50_s": cold.get("p50_s", 0.0),
+                            "cold_start_p95_s": cold.get("p95_s", 0.0)})
                 self._json(rows)
             elif path == "/metrics":
                 self._send(200, metrics.export_prometheus().encode(),
@@ -225,6 +241,49 @@ PREFETCH_WASTE_MIN_ISSUED = 20
 # the lifetime totals (first call judges the totals)
 _prefetch_last = {"issued": 0, "wasted": 0}
 
+# Serve autoscaler flap window (r14): direction reversals inside this
+# many seconds are counted against serve_flap_warn_reversals.
+SERVE_FLAP_WINDOW_S = 60.0
+
+
+def _serve_warnings(apps_status: dict, cfg) -> list:
+    """Serve-at-scale health checks (r14), factored pure so tests can
+    feed crafted status dicts: flag an autoscaler that keeps reversing
+    direction (flapping burns cold-starts and kills warm replicas —
+    raise the hysteresis windows/cooldowns) and a deployment whose
+    replica cold-start p95 blew the configured bound (weights are not
+    riding the broadcast path, or scale-ups queue behind placement)."""
+    warns = []
+    for app, info in (apps_status or {}).items():
+        for dn, dep in info.get("deployments", {}).items():
+            auto = dep.get("autoscaler") or {}
+            if auto.get("enabled"):
+                rev = auto.get("reversals_60s", 0)
+                if rev > cfg.serve_flap_warn_reversals:
+                    warns.append(
+                        f"serve {app}/{dn}: autoscaler flapping — {rev} "
+                        f"direction reversals in the last "
+                        f"{SERVE_FLAP_WINDOW_S:.0f}s "
+                        f"(> {cfg.serve_flap_warn_reversals}); raise "
+                        "upscale/downscale delay windows or cooldowns "
+                        "(AutoscalingConfig) — every flap burns a replica "
+                        "cold-start")
+            # cold-start applies to manual fleets too: a fixed
+            # num_replicas deployment missing the weights-by-ref path
+            # is exactly the misconfiguration this flags
+            cold = auto.get("cold_start") or {}
+            p95 = cold.get("p95_s", 0.0)
+            if cold.get("count", 0) >= 2 and \
+                    p95 > cfg.serve_cold_start_p95_warn_s:
+                warns.append(
+                    f"serve {app}/{dn}: replica cold-start p95 "
+                    f"{p95:.1f}s exceeds "
+                    f"{cfg.serve_cold_start_p95_warn_s:g}s — large init "
+                    "args may not be riding the weights-by-ref "
+                    "broadcast path (serve_weights_by_ref_min_bytes), "
+                    "or scale-ups are queueing behind placement")
+    return warns
+
 
 def doctor_warnings() -> list:
     """Health warnings that are not endpoint failures: nonzero
@@ -314,6 +373,17 @@ def doctor_warnings() -> list:
             "re-placing work away from its prefetches, or "
             "arg_prefetch_max_bytes/_max_inflight are misconfigured "
             "for the workload")
+    # serve autoscaler health (r14): reads the controller's status
+    # introspection; no serve running (or no controller) warns nothing
+    try:
+        from ray_tpu import serve
+        from ray_tpu.core.config import get_config
+
+        apps = serve.status().get("applications", {})
+        if apps:
+            warns.extend(_serve_warnings(apps, get_config()))
+    except Exception:  # noqa: BLE001 — controller gone mid-query
+        pass
     return warns
 
 

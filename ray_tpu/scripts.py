@@ -341,7 +341,34 @@ def cmd_serve_status(args):
     from ray_tpu import serve
 
     _attached(args)
-    print(json.dumps(serve.status(), indent=2, default=str))
+    status = serve.status()
+    print(json.dumps(status, indent=2, default=str))
+    # compact autoscaler digest (r14): one line per autoscaled
+    # deployment so a scale event is debuggable without jq — desired
+    # vs running, live queue depth, the last decision + reason, recent
+    # direction flips, and cold-start percentiles
+    lines = []
+    for app, info in status.get("applications", {}).items():
+        for dn, dep in info.get("deployments", {}).items():
+            auto = dep.get("autoscaler") or {}
+            if not auto.get("enabled"):
+                continue
+            last = auto.get("last_decision") or {}
+            cold = auto.get("cold_start") or {}
+            lines.append(
+                f"  {app}/{dn}: desired={auto.get('desired')} "
+                f"running={auto.get('running')} "
+                f"queue={auto.get('queue_depth')} "
+                f"reversals_60s={auto.get('reversals_60s')} "
+                f"cold_start_p50={cold.get('p50_s', 0)}s "
+                f"p95={cold.get('p95_s', 0)}s"
+                + (f"\n    last: {last.get('direction')} "
+                   f"{last.get('from')}->{last.get('to')} "
+                   f"({last.get('reason')})" if last else ""))
+    if lines:
+        print("autoscaler:")
+        for ln in lines:
+            print(ln)
     return 0
 
 

@@ -25,16 +25,85 @@ from .deployment import Application, Deployment
 from .handle import DeploymentHandle
 from .http_proxy import HTTPProxy, PROXY_NAME
 
+# Weights-by-ref put cache: content digest -> live ObjectRef. A repeat
+# serve.run() with the SAME weight bytes (the common redeploy: bump
+# num_replicas, tweak a config) reuses the prior ref, so the pickled
+# payload — and therefore the sha1-derived deployment version — stays
+# stable and the redeploy scales instead of rolling-restarting every
+# replica (and the store keeps ONE copy, not one per run). Changed
+# bytes change the digest -> new ref -> new version -> rolling update,
+# as intended. Bounded: evicted entries just drop this driver's pin
+# (the controller still holds refs for live deployments).
+_WEIGHTS_CACHE_MAX = 8
+_weights_ref_cache: "Dict[str, Any]" = {}
+_weights_cache_session: Optional[str] = None  # cluster the refs belong to
+
+
+def _weights_digest(obj) -> str:
+    """Content fingerprint of a large init arg (dtype/shape-aware for
+    arrays; hashlib reads the buffer without copying when contiguous)."""
+    import hashlib
+
+    h = hashlib.sha1()
+    if isinstance(obj, (bytes, bytearray)):
+        h.update(b"raw")
+        h.update(obj)
+    else:
+        import numpy as np
+
+        a = np.ascontiguousarray(np.asarray(obj))
+        h.update(f"{a.dtype.str}{a.shape}".encode())
+        h.update(memoryview(a).cast("B"))
+    return h.hexdigest()
+
+
+def _put_weights(obj):
+    """put() a large init arg through the digest cache (see above)."""
+    from ray_tpu.core.context import get_context
+
+    # Cached refs are only valid within the cluster that minted them: a
+    # shutdown()/init() cycle in the same process would otherwise hand a
+    # redeploy a ref into the dead cluster's object store.
+    global _weights_cache_session
+    session = get_context().session_dir
+    if session != _weights_cache_session:
+        _weights_ref_cache.clear()
+        _weights_cache_session = session
+    dig = _weights_digest(obj)
+    ref = _weights_ref_cache.pop(dig, None)  # pop+reinsert = LRU bump
+    if ref is None:
+        ref = ray_tpu.put(obj)
+    _weights_ref_cache[dig] = ref
+    while len(_weights_ref_cache) > _WEIGHTS_CACHE_MAX:
+        del _weights_ref_cache[next(iter(_weights_ref_cache))]
+    return ref
+
 
 def _collect_app(app: Application) -> List[dict]:
-    """Flatten the application graph into replica-spec payloads."""
+    """Flatten the application graph into replica-spec payloads.
+
+    Weights-by-ref (r14): init args that are large arrays/blobs (an
+    integer ``nbytes`` >= ``serve_weights_by_ref_min_bytes``, or
+    bytes/bytearray of that size) are put() into the object store ONCE
+    here and replaced by their ObjectRef in the payload — replicas
+    fetch them through the object plane (cooperative broadcast under
+    concurrent cold-starts, zero-copy typed reducer) instead of each
+    unpickling a private copy out of CREATE_ACTOR args. Explicit
+    ObjectRef init args ride the same path. The live refs are ALSO
+    returned per deployment (``weights_refs``) so the controller can
+    hold them (outliving this driver's locals) and pre-warm them at
+    scale-up decision time."""
     import inspect
+
+    from ray_tpu.core.config import get_config
+    from ray_tpu.core.object_ref import ObjectRef
 
     from .replica import HandleMarker
 
     out: Dict[str, dict] = {}
+    thr = get_config().serve_weights_by_ref_min_bytes
 
-    def mark(obj, app_name: str):
+    def mark(obj, app_name: str, weights: list):
         if isinstance(obj, Application):
             visit(obj, app_name)
             return HandleMarker(obj.deployment.name, app_name)
@@ -42,10 +111,23 @@ def _collect_app(app: Application) -> List[dict]:
             raise TypeError(
                 f"pass '{obj.name}.bind(...)' (an Application), not the "
                 f"bare Deployment, as an init arg")
+        if isinstance(obj, ObjectRef):
+            weights.append(obj)
+            return obj
+        if thr > 0:
+            if isinstance(obj, (bytes, bytearray)):
+                nbytes = len(obj)
+            else:
+                nbytes = getattr(obj, "nbytes", None)
+            if isinstance(nbytes, int) and nbytes >= thr:
+                ref = _put_weights(obj)
+                weights.append(ref)
+                return ref
         if isinstance(obj, (list, tuple)):
-            return type(obj)(mark(x, app_name) for x in obj)
+            return type(obj)(mark(x, app_name, weights) for x in obj)
         if isinstance(obj, dict):
-            return {k: mark(v, app_name) for k, v in obj.items()}
+            return {k: mark(v, app_name, weights)
+                    for k, v in obj.items()}
         return obj
 
     def visit(node: Application, app_name: str):
@@ -53,8 +135,10 @@ def _collect_app(app: Application) -> List[dict]:
         if dep.name in out:
             return
         out[dep.name] = {}  # reserve before recursing (cycle guard)
-        init_args = tuple(mark(a, app_name) for a in node.init_args)
-        init_kwargs = {k: mark(v, app_name)
+        weights: list = []
+        init_args = tuple(mark(a, app_name, weights)
+                          for a in node.init_args)
+        init_kwargs = {k: mark(v, app_name, weights)
                        for k, v in node.init_kwargs.items()}
         spec = {
             "func_or_class": dep.func_or_class,
@@ -64,7 +148,7 @@ def _collect_app(app: Application) -> List[dict]:
             "user_config": dep.config.user_config,
         }
         out[dep.name] = {"name": dep.name, "payload": dumps(spec),
-                         "config": dep.config}
+                         "config": dep.config, "weights_refs": weights}
 
     # app_name resolved by caller; placeholder substituted there
     visit(app, "__APP__")
@@ -103,7 +187,8 @@ def run(target: Application, *, name: str = "default",
         spec["init_args"] = walk(spec["init_args"])
         spec["init_kwargs"] = walk(spec["init_kwargs"])
         deployments.append({"name": d["name"], "payload": dumps(spec),
-                            "config": d["config"]})
+                            "config": d["config"],
+                            "weights_refs": d.get("weights_refs") or []})
 
     ray_tpu.get(ctrl.deploy_app.remote(
         name, route_prefix, target.deployment.name, deployments),

@@ -63,6 +63,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                autoscaling_config=None,
                ray_actor_options: Optional[dict] = None,
                health_check_period_s: Optional[float] = None,
+               health_check_timeout_s: Optional[float] = None,
                graceful_shutdown_timeout_s: Optional[float] = None,
                version: Optional[str] = None) -> Any:
     """``@serve.deployment`` — wrap a class or function as a Deployment."""
@@ -86,6 +87,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             cfg.ray_actor_options = dict(ray_actor_options)
         if health_check_period_s is not None:
             cfg.health_check_period_s = health_check_period_s
+        if health_check_timeout_s is not None:
+            cfg.health_check_timeout_s = health_check_timeout_s
         if graceful_shutdown_timeout_s is not None:
             cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
         cfg.version = version

@@ -200,10 +200,18 @@ class HTTPProxy:
         try:
             arg = None
             if body:
-                try:
-                    arg = json.loads(body)
-                except json.JSONDecodeError:
-                    arg = body.decode("utf-8", "replace")
+                if headers.get("content-type", "").startswith(
+                        "application/octet-stream"):
+                    # raw-bytes passthrough (r14): binary payloads must
+                    # not be lossily utf-8-decoded, and a large body
+                    # handed to the handle as bytes rides the zero-copy
+                    # by-ref ingress path end-to-end
+                    arg = body
+                else:
+                    try:
+                        arg = json.loads(body)
+                    except json.JSONDecodeError:
+                        arg = body.decode("utf-8", "replace")
             loop = asyncio.get_running_loop()
             handle = await loop.run_in_executor(
                 self._pool, self._app_handle, app)

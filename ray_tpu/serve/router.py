@@ -7,6 +7,23 @@ replica, picks the less-loaded of two random replicas, and blocks (with
 backpressure) when every replica is at ``max_concurrent_queries``. Replica
 membership is refreshed from the controller when its ``routing_version``
 moves (polled with a small TTL; the reference uses a long-poll broker).
+
+r14 additions:
+
+- **Slow-node awareness**: the routing snapshot carries the set of nodes
+  the head's ``slow_node`` detector currently flags; replicas on flagged
+  nodes are DEPRIORITIZED — power-of-two-choices runs over the clean
+  pool and falls back to flagged replicas only when every clean one is
+  at its concurrency bound (degraded capacity still beats a timeout).
+- **Queue-depth reporting**: each snapshot refresh piggybacks this
+  router's per-replica in-flight counts PLUS the callers currently
+  blocked in ``_acquire_replica`` (reserved ``__waiting__`` key) to the
+  controller, which fuses them across router processes into the
+  autoscaler's queue-depth signal — the replica itself only sees
+  requests its executor already started, and slot counts alone saturate
+  at capacity, so in-flight + waiters IS the queue. No extra RPC: the
+  report rides the refresh the router makes anyway, keeping the
+  controller off the per-request hot path.
 """
 
 from __future__ import annotations
@@ -14,7 +31,7 @@ from __future__ import annotations
 import random
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, FrozenSet, List, Optional, Tuple
 
 import ray_tpu
 
@@ -23,12 +40,21 @@ _REFRESH_TTL_S = 0.25
 
 class Router:
     def __init__(self, app_name: str, deployment: str):
+        from ray_tpu.core.ids import _random_bytes
+
         self.app = app_name
         self.deployment = deployment
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._replicas: List[Tuple[str, object]] = []  # (replica_id, handle)
+        # (replica_id, handle, node_idx) triples
+        self._replicas: List[Tuple[str, object, int]] = []
+        self._slow_nodes: FrozenSet[int] = frozenset()
         self._inflight: Dict[str, int] = {}
+        # callers blocked in _acquire_replica: demand beyond capacity.
+        # Slot-holding counts saturate at n_replicas * max_q, so without
+        # this the autoscaler can never see a backlog past capacity
+        # (and would happily SHRINK a saturated fleet).
+        self._waiting = 0
         self._max_q = 1
         self._version = -1
         self._last_refresh = 0.0
@@ -36,6 +62,8 @@ class Router:
         self._model_affinity: Dict[str, str] = {}  # model_id -> replica_id
         self._drainer: Optional[threading.Thread] = None
         self._controller = None
+        # stable identity for the controller's per-router depth table
+        self._router_id = _random_bytes(8).hex()
 
     # ------------------------------------------------------------ membership
 
@@ -52,15 +80,23 @@ class Router:
             return
         self._last_refresh = now
         ctrl = self._controller_handle()
-        version, replicas, max_q = ray_tpu.get(
-            ctrl.get_routing_snapshot.remote(self.app, self.deployment),
+        with self._lock:
+            depths = dict(self._inflight)
+            if self._waiting:
+                # reserved key (replica ids are hex): fused into the
+                # controller's queue-depth sum like any replica count
+                depths["__waiting__"] = self._waiting
+        version, replicas, max_q, slow = ray_tpu.get(
+            ctrl.get_routing_snapshot.remote(self.app, self.deployment,
+                                             self._router_id, depths),
             timeout=30)
         with self._lock:
+            self._slow_nodes = frozenset(slow)
             if version != self._version:
                 self._version = version
                 self._replicas = replicas
                 self._max_q = max(1, max_q)
-                known = {rid for rid, _ in replicas}
+                known = {rid for rid, _, _ in replicas}
                 self._inflight = {rid: self._inflight.get(rid, 0)
                                   for rid in known}
                 self._cond.notify_all()
@@ -72,12 +108,14 @@ class Router:
         """Pick a replica (power of two choices) and push the request.
 
         Returns the resulting ObjectRef. Blocks while all replicas are at
-        max_concurrent_queries (client-side backpressure)."""
+        max_concurrent_queries (client-side backpressure). Positional
+        request args ship as REAL task args (``*args`` tail) so by-ref
+        payloads ride the zero-copy wire path end-to-end."""
         rid, handle = self._acquire_replica(timeout_s, meta)
         ref = None
         try:
             ref = handle.handle_request.remote(
-                method_name, args, kwargs, meta)
+                method_name, kwargs, meta, *args)
             with self._lock:
                 self._outstanding[ref] = rid
                 self._ensure_drainer_locked()
@@ -96,7 +134,7 @@ class Router:
         rid, handle = self._acquire_replica(timeout_s, meta)
         try:
             sid_ref = handle.start_stream.remote(
-                method_name, args, kwargs, meta)
+                method_name, kwargs, meta, *args)
         except BaseException:
             self.release(rid)
             raise
@@ -112,44 +150,71 @@ class Router:
         self._refresh()
         model_id = (meta or {}).get("multiplexed_model_id", "")
         deadline = time.monotonic() + timeout_s
-        while True:
-            with self._lock:
-                choice = self._choose_locked(model_id)
-                if choice is not None:
-                    rid, handle = choice
-                    self._inflight[rid] = self._inflight.get(rid, 0) + 1
-                    if model_id:
-                        # pin affinity only when the model has no live
-                        # holder: a request spilling off a momentarily
-                        # saturated holder must not migrate the model
-                        # (load/evict ping-pong under bursts)
-                        cur = self._model_affinity.get(model_id)
-                        if cur is None or cur not in {
-                                r for r, _ in self._replicas}:
-                            self._model_affinity[model_id] = rid
-                    return rid, handle
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise TimeoutError(
-                        f"no replica of {self.app}/{self.deployment} "
-                        f"available within {timeout_s}s")
-                self._cond.wait(min(remaining, _REFRESH_TTL_S))
-            self._refresh(force=not self._replicas)
+        waiting = False
+        try:
+            while True:
+                with self._lock:
+                    choice = self._choose_locked(model_id)
+                    if choice is not None:
+                        rid, handle = choice
+                        self._inflight[rid] = \
+                            self._inflight.get(rid, 0) + 1
+                        if model_id:
+                            # pin affinity only when the model has no
+                            # live holder: a request spilling off a
+                            # momentarily saturated holder must not
+                            # migrate the model (load/evict ping-pong
+                            # under bursts)
+                            cur = self._model_affinity.get(model_id)
+                            if cur is None or cur not in {
+                                    r for r, _, _ in self._replicas}:
+                                self._model_affinity[model_id] = rid
+                        return rid, handle
+                    if not waiting:
+                        # blocked past capacity: count this caller into
+                        # the queue-depth report (see _waiting)
+                        waiting = True
+                        self._waiting += 1
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"no replica of {self.app}/{self.deployment} "
+                            f"available within {timeout_s}s")
+                    self._cond.wait(min(remaining, _REFRESH_TTL_S))
+                self._refresh(force=not self._replicas)
+        finally:
+            if waiting:
+                with self._lock:
+                    self._waiting -= 1
 
     def _choose_locked(self, model_id: str = ""
                        ) -> Optional[Tuple[str, object]]:
-        avail = [(rid, h) for rid, h in self._replicas
-                 if self._inflight.get(rid, 0) < self._max_q]
-        if not avail:
-            return None
         if model_id:
             # multiplexing affinity: prefer the replica that already holds
             # the model, unless it is saturated (ref: multiplexed routing
-            # in the reference's replica scheduler)
+            # in the reference's replica scheduler). The holder rides
+            # THROUGH the slow-node filter below — the model is already
+            # resident there, and re-loading it on a clean replica costs
+            # more than the flagged host's latency (and would scatter the
+            # model into the load/evict ping-pong the pin exists to stop)
             want = self._model_affinity.get(model_id)
-            for rid, h in avail:
-                if rid == want:
-                    return rid, h
+            if want is not None:
+                for rid, h, _n in self._replicas:
+                    if rid == want and \
+                            self._inflight.get(rid, 0) < self._max_q:
+                        return rid, h
+        avail = [(rid, h) for rid, h, n in self._replicas
+                 if self._inflight.get(rid, 0) < self._max_q
+                 and n not in self._slow_nodes]
+        if not avail:
+            # every clean replica is saturated (or none exist): fall
+            # back to replicas on detector-flagged nodes — a slow host
+            # still beats refusing the request (the reference likewise
+            # soft-deprioritizes rather than hard-drains)
+            avail = [(rid, h) for rid, h, _n in self._replicas
+                     if self._inflight.get(rid, 0) < self._max_q]
+        if not avail:
+            return None
         if len(avail) == 1:
             return avail[0]
         a, b = random.sample(avail, 2)

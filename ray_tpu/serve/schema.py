@@ -27,7 +27,8 @@ from typing import Any, Dict, List, Optional
 
 _DEPLOYMENT_OVERRIDES = ("num_replicas", "max_concurrent_queries",
                          "user_config", "autoscaling_config",
-                         "ray_actor_options", "health_check_period_s")
+                         "ray_actor_options", "health_check_period_s",
+                         "health_check_timeout_s")
 
 
 def load_config_file(path: str) -> Dict[str, Any]:

@@ -149,6 +149,22 @@ def summarize_tasks(limit: int = 10_000) -> Dict[str, Any]:
     }
 
 
+def phase_summary(funcs: Optional[Sequence[str]] = None
+                  ) -> Dict[str, Dict[str, Any]]:
+    """Func-scoped per-phase percentile summary — the focused slice of
+    ``summarize_tasks()["phases"]`` (r14): ``{func: {phase: {count,
+    mean_ms, p50_ms, p95_ms, p99_ms}}}`` for just the named funcs
+    (all funcs when None). One small head RPC regardless of how many
+    funcs the cluster has run; the serve controller polls this for its
+    SLO-burn autoscaling signal (p99 of the replica methods' exec/e2e
+    phases) without shipping the whole task summary every tick."""
+    kind = "phase_summary"
+    if funcs:
+        kind += ":" + ",".join(funcs)
+    rows = _query(kind, 1)
+    return rows[0] if rows else {}
+
+
 def summarize_actors(limit: int = 10_000) -> Dict[str, Any]:
     rows = list_actors(limit=limit)
     states = Counter(r["state"] for r in rows)

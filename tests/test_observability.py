@@ -558,6 +558,38 @@ def test_slow_node_skew_event(ray_start):
         filters=[("type", "=", "slow_node")])) == len(evs)
 
 
+def test_slow_node_flag_recovers_when_skew_is_history(ray_start):
+    """The skew check judges the delta since the last sweep, not the
+    lifetime histogram: once a flagged node's NEW samples are in line
+    with the cluster, the routable-around flag stops being re-stamped
+    (the TTL is left to lapse) even though the cumulative p95 stays
+    skewed forever."""
+    from ray_tpu.core.api import _head
+
+    _head.add_node(num_cpus=1, num_tpus=0)  # nodes 0,1,2 live
+    _head.add_node(num_cpus=1, num_tpus=0)
+    with _head._lock:
+        for _ in range(10):
+            for node, ms in (("0", 4.0), ("1", 4.0), ("2", 800.0)):
+                _head._observe_phase_hist(
+                    "task.node_phase_ms", "test",
+                    {"node": node, "phase": "arg_fetch"}, ms)
+    _head.detect_stragglers()
+    assert 2 in _head._slow_node_until, "skewed node not flagged"
+    deadline = _head._slow_node_until[2]
+    # node 2 recovered: its fresh samples match the cluster. The
+    # lifetime histogram still carries the stall, but the per-sweep
+    # delta is clean, so the flag deadline must NOT move.
+    with _head._lock:
+        for _ in range(10):
+            for node in ("0", "1", "2"):
+                _head._observe_phase_hist(
+                    "task.node_phase_ms", "test",
+                    {"node": node, "phase": "arg_fetch"}, 4.0)
+    _head.detect_stragglers()
+    assert _head._slow_node_until[2] == deadline
+
+
 def test_terminal_fold_owner_failures_and_retries(ray_start):
     """Owner-side task death folds a terminal FAILED (never wedging the
     timeline at RUNNING, which would feed false stragglers) without

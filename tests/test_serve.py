@@ -144,7 +144,7 @@ class TestScaling:
         assert h.remote().result(timeout_s=30) == 1
         # find and kill the replica actor through the controller snapshot
         ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
-        _, replicas, _ = ray_tpu.get(
+        _, replicas, _, _ = ray_tpu.get(
             ctrl.get_routing_snapshot.remote("recover", "phoenix"),
             timeout=30)
         ray_tpu.kill(replicas[0][1])
@@ -373,10 +373,18 @@ class TestAutoscalePolicyUnit:
         assert dep.autoscale_desired == 4
         assert dep._below_since is None
         scale(1, now=2.0)      # dip right after the upscale episode
-        # with the stale timer this would read 2.0 - 0.0 >= 1.5 and shrink
+        # with the stale timer this would read 2.0 - 0.0 >= 1.5 and
+        # shrink; r14 holds even longer — the burst sample is still
+        # inside the downscale look-back window, so the averaged signal
+        # is not even "below" yet
         assert dep.autoscale_desired == 4
-        assert dep._below_since == 2.0
-        scale(1, now=4.0)      # genuine sustained dip -> now it may shrink
+        assert dep._below_since is None
+        scale(1, now=3.0)      # burst rolled out of the window: timer arms
+        assert dep.autoscale_desired == 4
+        assert dep._below_since == 3.0
+        scale(1, now=4.0)      # 1.0s below < downscale_delay_s: still held
+        assert dep.autoscale_desired == 4
+        scale(1, now=4.6)      # sustained 1.6s >= 1.5s -> now it shrinks
         assert dep.autoscale_desired == 1
 
 
