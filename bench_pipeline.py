@@ -1,0 +1,426 @@
+"""MPMD pipeline A/B bench -> BENCH_pipeline_r15.json.
+
+Two phases (bench_scale conventions: ``--phases``/``--out``, per-phase
+``loop_lag`` blocks, JSON merge across processes so phases can run as
+separate processes; interleaved A/B pairs, median-of-pairwise — this
+host has multi-x run drift, so only paired ratios in one window mean
+anything). Inter-node links are PACED (`RAY_TPU_HOST_EGRESS_LIMIT_BPS`
+seeds every process's transfer-server token bucket) — unpaced loopback
+finishes a 2 MiB activation hop in ~1 ms and hides exactly the transfer
+the pipeline exists to overlap.
+
+1. **schedule** — 4-stage x 8-microbatch 1F1B vs the sequential
+   single-program baseline: the SAME raw stages (sleep-paced compute,
+   2 MiB activations) run (a) one actor per node with store-to-store
+   activation handoff + prefetch-overlapped pulls, vs (b) one actor
+   executing all four stages per microbatch, no handoff at all.
+   Sequential wall is M*S*(Tf+Tb); 1F1B's is ~(M+S-1)*(Tf+Tb) plus any
+   transfer it fails to hide. Gate: wall ratio <= 0.5.
+
+2. **hints** — same pipeline, ``arg_prefetch_enabled`` ON vs OFF. Actor
+   tasks have no grant-time prefetch, so the dispatch-time
+   PREFETCH_HINT path (r14 actor keys + r15 coalescing) is the ONLY
+   speculation — toggling it isolates the handoff-overlap win on the
+   consuming stages' ``arg_fetch`` p95 (the pull starts while the
+   consumer still computes the previous microbatch instead of cold
+   inside ``_decode_args``). Rounds are tagged via ``Pipeline.
+   name_prefix`` so the cumulative phase histograms stay separable.
+   Gates: median p95 reduction >= 30%, prefetch_wasted < 10% of issued.
+
+Run: python bench_pipeline.py [--pairs 3] [--phases schedule,hints]
+     [--out BENCH_pipeline_r15.json]
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TPU_CHIPS", "0")
+os.environ.setdefault("RAY_TPU_PRESTART_WORKERS", "0")
+
+# paced inter-node links: every process (head host + each agent
+# "host") seeds its TransferServer bucket from this env var at init
+LINK_MIB_S = 40
+os.environ.setdefault("RAY_TPU_HOST_EGRESS_LIMIT_BPS",
+                      str(LINK_MIB_S * 1024 * 1024))
+
+ACT_ELEMS = (1 << 20) // 4  # 1 MiB fp32 activations
+TF, TB = 0.4, 0.4           # per-stage fwd/bwd compute (sleep-paced):
+#                             deep enough that the fixed per-hop costs
+#                             (paced 25 ms activation/grad pulls on the
+#                             B-chain critical path, driver dispatch
+#                             round-trips, 2-vCPU scheduler jitter)
+#                             amortize — at 0.25 s/op they ate ~35% of
+#                             the schedule's ideal win on this host
+STAGES = 4
+MICRO = 8
+
+
+def _median(xs):
+    return statistics.median(xs) if xs else 0.0
+
+
+class _LoopLag:
+    """Per-phase head loop-lag capture (bench_scale convention)."""
+
+    def snap(self):
+        from ray_tpu import state
+
+        try:
+            row = state.io_loop_stats()[0]
+        except Exception:  # noqa: BLE001 — no cluster yet
+            row = {}
+        self._before = row
+        return self
+
+    def delta(self) -> dict:
+        from ray_tpu import state
+
+        try:
+            row = state.io_loop_stats()[0]
+        except Exception:  # noqa: BLE001
+            return {}
+        before = getattr(self, "_before", {})
+        return {
+            "loop_lag_ms_p50": row.get("loop_lag_ms_p50", 0.0),
+            "loop_lag_ms_p99": row.get("loop_lag_ms_p99", 0.0),
+            "loop_lag_ms_max": row.get("loop_lag_ms_max", 0.0),
+            "slow_events": row.get("slow_events", 0)
+            - before.get("slow_events", 0),
+            "fold_queue_drops": row.get("fold_queue_drops", 0)
+            - before.get("fold_queue_drops", 0),
+        }
+
+
+def _mk_stages(n_stages, tf, tb, grad_elems=ACT_ELEMS):
+    """Raw-mode stages: sleep-paced compute, fresh 2 MiB activations
+    (and, by default, grads) each hop, scalar loss off the last stage.
+    ``grad_elems`` small makes backward cotangents inline — the hints
+    phase uses it to isolate the FORWARD activation handoff."""
+    import numpy as np
+
+    def fwd_mid(params, x):
+        time.sleep(tf)
+        return np.full(ACT_ELEMS, 1.0, np.float32), None
+
+    def fwd_last(params, x):
+        time.sleep(tf)
+        return float(np.asarray(x).ravel()[0]), None
+
+    def bwd_mid(params, saved, g):
+        time.sleep(tb)
+        return None, np.full(grad_elems, 0.5, np.float32)
+
+    def bwd_first(params, saved, g):
+        time.sleep(tb)
+        return None, None
+
+    from ray_tpu.train.pipeline import PipelineStage
+
+    stages = []
+    for k in range(n_stages):
+        stages.append(PipelineStage(
+            fwd=fwd_last if k == n_stages - 1 else fwd_mid,
+            bwd=bwd_first if k == 0 else bwd_mid))
+    return stages
+
+
+HINT_ACT_ELEMS = (1 << 20) // 4  # 1 MiB activations (hints phase)
+
+
+def _mk_hetero_stages(tfs, tb):
+    """Raw-mode stages with per-stage forward times (each consumer
+    slower than its producer -> real backlog at every hop) and tiny
+    inline backward cotangents."""
+    import numpy as np
+
+    from ray_tpu.train.pipeline import PipelineStage
+
+    n = len(tfs)
+
+    def mk_fwd(tf, last):
+        def fwd(params, x):
+            time.sleep(tf)
+            if last:
+                return float(np.asarray(x).ravel()[0]), None
+            return np.full(HINT_ACT_ELEMS, 1.0, np.float32), None
+
+        return fwd
+
+    def bwd_mid(params, saved, g):
+        time.sleep(tb)
+        return None, np.full(8, 0.5, np.float32)
+
+    def bwd_first(params, saved, g):
+        time.sleep(tb)
+        return None, None
+
+    return [PipelineStage(fwd=mk_fwd(tfs[k], k == n - 1),
+                          bwd=bwd_first if k == 0 else bwd_mid)
+            for k in range(n)]
+
+
+def _start_cluster(n_remote):
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1, "num_tpus": 0,
+                                      "object_store_memory": 1 << 30})
+    handles = [cluster.add_remote_node(num_cpus=1,
+                                       object_store_memory=512 << 20)
+               for _ in range(n_remote)]
+    return cluster, handles
+
+
+# ------------------------------------------------------------ schedule
+
+
+def bench_schedule(pairs: int) -> dict:
+    import ray_tpu
+    import ray_tpu.core.api as core_api
+    from ray_tpu.core.api import NodeAffinitySchedulingStrategy
+    from ray_tpu.train.pipeline import Pipeline, SingleProgramPipeline
+
+    cluster, handles = _start_cluster(STAGES)
+    head = core_api._head
+    lag = _LoopLag().snap()
+    stages = _mk_stages(STAGES, TF, TB)
+    mbs = [float(i) for i in range(MICRO)]
+    try:
+        # pipeline: auto placement round-robins the 5 alive nodes —
+        # stage0 lands on the head host, stages 1-3 on agents, so every
+        # handoff crosses a paced link; the baseline actor gets the
+        # remaining agent node so it never shares a CPU with a stage
+        pipe = Pipeline(stages, schedule="1f1b")
+        seq = SingleProgramPipeline(
+            stages, scheduling_strategy=NodeAffinitySchedulingStrategy(
+                handles[-1].node_idx))
+        # warm both: actor/worker spawn + first-touch paths
+        pipe.run_batch(mbs[:2], by_ref_min_bytes=0)
+        seq.run_batch(mbs[:2], by_ref_min_bytes=0)
+        rows = []
+        served0 = head._transfer_server.bytes_served
+        for i in range(pairs):
+            t0 = time.perf_counter()
+            seq.run_batch(mbs, by_ref_min_bytes=0)
+            seq_wall = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            pipe.run_batch(mbs, by_ref_min_bytes=0)
+            pipe_wall = time.perf_counter() - t0
+            rows.append({"seq_wall_s": round(seq_wall, 3),
+                         "pipe_wall_s": round(pipe_wall, 3),
+                         "ratio": round(pipe_wall / seq_wall, 3)})
+            print(f"  pair {i}: seq {seq_wall:.2f}s "
+                  f"pipe {pipe_wall:.2f}s "
+                  f"ratio {pipe_wall / seq_wall:.3f}",
+                  file=sys.stderr, flush=True)
+        served = head._transfer_server.bytes_served - served0
+        lag_delta = lag.delta()
+        pipe.shutdown()
+        seq.shutdown()
+    finally:
+        for h in handles:
+            try:
+                h.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+        cluster.shutdown()
+    ratio = _median([r["ratio"] for r in rows])
+    ideal = (MICRO + STAGES - 1) / (MICRO * STAGES)
+    return {
+        "stages": STAGES, "microbatches": MICRO,
+        "fwd_s": TF, "bwd_s": TB,
+        "activation_mib": ACT_ELEMS * 4 / 2**20,
+        "link_mib_s": LINK_MIB_S,
+        "pairs": rows,
+        "wall_ratio_median_of_pairs": ratio,
+        "ideal_ratio_no_transfer": round(ideal, 3),
+        "head_host_egress_mib": round(served / 2**20, 1),
+        "gate_ratio_le_0_5": ratio <= 0.5,
+        "loop_lag": lag_delta,
+    }
+
+
+# --------------------------------------------------------------- hints
+
+
+def bench_hints(pairs: int) -> dict:
+    import ray_tpu
+    import ray_tpu.core.api as core_api
+    from ray_tpu import state
+    from ray_tpu.core.config import get_config
+    from ray_tpu.train.pipeline import Pipeline
+
+    # The overlap window a dispatch-time hint exploits is the
+    # consumer's BACKLOG: the pull runs while the consumer finishes
+    # the ops already queued ahead. A perfectly rate-matched uniform
+    # pipeline has near-zero queue at every hop (each activation
+    # arrives just-in-time), so to measure the hint win where it
+    # exists — and where real pipelines live — the stages are
+    # HETEROGENEOUS: each stage slower than its producer, so every
+    # hop's consumer carries a growing backlog that a prefetched pull
+    # hides under (and a cold demand pull serializes in front of).
+    M = 24
+    tfs = [0.10 + 0.12 * k for k in range(STAGES)]
+    tb = 0.03
+    cluster, handles = _start_cluster(STAGES - 1)
+    head = core_api._head
+    lag = _LoopLag().snap()
+    # AFTER init (the r13 footgun this round also FIXED in
+    # reset_config — a pre-init reference now stays live; re-fetch
+    # anyway to keep the bench honest about ordering)
+    cfg = get_config()
+    # tiny (inline-sized) backward cotangents: the large by-ref
+    # traffic is then EXACTLY the forward activation handoff the hint
+    # A/B measures — 2 MiB grads would contend for the same paced
+    # links and smear both sides' arg_fetch with queueing noise
+    stages = _mk_hetero_stages(tfs, tb)
+    mbs = [float(i) for i in range(M)]
+    consumer_stages = list(range(1, STAGES))
+
+    pipe = Pipeline(stages, schedule="1f1b")
+
+    def one_round(tag: str, on: bool) -> dict:
+        from ray_tpu.core.context import get_context
+
+        cfg.arg_prefetch_enabled = on
+        pipe.name_prefix = f"h{tag}_"
+        funcs = [f"h{tag}_stage{k}.fwd" for k in consumer_stages]
+        iss0 = head.prefetch_issued
+        wst0 = head.prefetch_wasted
+        join0 = head.prefetch_joined
+        t0 = time.perf_counter()
+        pipe.run_batch(mbs, by_ref_min_bytes=0)
+        wall = time.perf_counter() - t0
+        get_context().events.flush(sync=True)
+        # stage workers flush event buffers on their own cadence
+        deadline = time.perf_counter() + 30
+        phases = {}
+        while time.perf_counter() < deadline:
+            phases = state.phase_summary(funcs)
+            if all(f in phases
+                   and phases[f].get("exec", {}).get("count", 0) >= M
+                   for f in funcs):
+                break
+            time.sleep(0.25)
+        p95s = {k: phases[f].get("arg_fetch", {}).get("p95_ms", 0.0)
+                for k, f in zip(consumer_stages, funcs)}
+        time.sleep(1.5)  # borrow-grace drain before the next round
+        return {
+            "prefetch": on, "wall_s": round(wall, 3),
+            "arg_fetch_p95_ms_by_stage": {
+                str(k): round(v, 2) for k, v in p95s.items()},
+            "arg_fetch_p95_ms_median": round(
+                _median(list(p95s.values())), 2),
+            "prefetch_issued": head.prefetch_issued - iss0,
+            "prefetch_joined": head.prefetch_joined - join0,
+            "prefetch_wasted": head.prefetch_wasted - wst0,
+        }
+
+    prev = cfg.arg_prefetch_enabled
+    rows = []
+    try:
+        one_round("warm", False)  # spawn + import the stage workers
+        for i in range(pairs):
+            off = one_round(f"off{i}", False)
+            on = one_round(f"on{i}", True)
+            red = (1.0 - on["arg_fetch_p95_ms_median"]
+                   / off["arg_fetch_p95_ms_median"]) \
+                if off["arg_fetch_p95_ms_median"] else 0.0
+            rows.append({"off": off, "on": on,
+                         "p95_reduction": round(red, 3)})
+            print(f"  pair {i}: off p95 "
+                  f"{off['arg_fetch_p95_ms_median']}ms on p95 "
+                  f"{on['arg_fetch_p95_ms_median']}ms "
+                  f"(-{red * 100:.0f}%)", file=sys.stderr, flush=True)
+        lag_delta = lag.delta()
+        pipe.shutdown()
+    finally:
+        cfg.arg_prefetch_enabled = prev
+        for h in handles:
+            try:
+                h.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+        cluster.shutdown()
+    issued = sum(r["on"]["prefetch_issued"] for r in rows)
+    wasted = sum(r["on"]["prefetch_wasted"] for r in rows)
+    reduction = _median([r["p95_reduction"] for r in rows])
+    return {
+        "stages": STAGES, "microbatches": M,
+        "fwd_s_by_stage": tfs, "bwd_s": tb,
+        "activation_mib": HINT_ACT_ELEMS * 4 / 2**20,
+        "link_mib_s": LINK_MIB_S,
+        "pairs": rows,
+        "arg_fetch_p95_ms_median": {
+            "off": _median([r["off"]["arg_fetch_p95_ms_median"]
+                            for r in rows]),
+            "on": _median([r["on"]["arg_fetch_p95_ms_median"]
+                           for r in rows])},
+        "p95_reduction_median_of_pairs": reduction,
+        "prefetch_issued_total": issued,
+        "prefetch_joined_total": sum(
+            r["on"]["prefetch_joined"] for r in rows),
+        "prefetch_wasted_total": wasted,
+        "wasted_ratio": round(wasted / issued, 4) if issued else 0.0,
+        "gate_p95_reduction_ge_30pct": reduction >= 0.30,
+        "gate_wasted_lt_10pct": (wasted / issued if issued else 0.0)
+        < 0.10,
+        "loop_lag": lag_delta,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pairs", type=int, default=3)
+    ap.add_argument("--phases", default="schedule,hints",
+                    help="comma list: schedule,hints")
+    ap.add_argument("--out", default="BENCH_pipeline_r15.json")
+    args = ap.parse_args()
+    phases = {p.strip() for p in args.phases.split(",") if p.strip()}
+
+    result = {
+        "benchmark": "pipeline_r15",
+        "hardware": f"single host, {os.cpu_count()} cpu, "
+                    "real agent processes, per-process egress buckets",
+        "methodology": "interleaved A/B pairs, median-of-pairwise "
+                       "(MICROBENCH_r6); paced inter-node links",
+    }
+    # merge a prior artifact: phases may run as separate processes so
+    # one phase's copy storms don't contaminate the other's tails
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                prior = json.load(f)
+            for k in ("schedule", "hints"):
+                if k in prior:
+                    result[k] = prior[k]
+        except (OSError, ValueError):
+            pass
+
+    def flush():
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+
+    if "schedule" in phases:
+        print(f"# schedule: {STAGES}-stage x {MICRO}-microbatch 1F1B "
+              f"vs sequential, {args.pairs} pairs",
+              file=sys.stderr, flush=True)
+        result["schedule"] = bench_schedule(args.pairs)
+        print(json.dumps(result["schedule"]), file=sys.stderr)
+        flush()
+    if "hints" in phases:
+        print(f"# hints A/B, {args.pairs} pairs", file=sys.stderr,
+              flush=True)
+        result["hints"] = bench_hints(args.pairs)
+        print(json.dumps(result["hints"]), file=sys.stderr)
+        flush()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -300,20 +300,28 @@ class RemoteFunction:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns=1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns=1,
+                 task_name: str = ""):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._task_name = task_name
 
     def remote(self, *args, **kwargs):
         refs = get_context().submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs,
             num_returns=self._num_returns,
-            max_retries=self._handle._max_task_retries)
+            max_retries=self._handle._max_task_retries,
+            name=self._task_name)
         return refs[0] if self._num_returns == 1 else refs
 
-    def options(self, num_returns=1, **_):
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns=1, name: str = "", **_):
+        """``name`` relabels the submitted task for observability (the
+        func key of phase histograms / `summary tasks` / the straggler
+        detector) without changing which method runs — pipeline stages
+        submit ``fwd`` as ``stage{k}.fwd`` this way (r15)."""
+        return ActorMethod(self._handle, self._name, num_returns,
+                           task_name=name)
 
     def __call__(self, *a, **k):
         raise TypeError(f"Actor method '{self._name}' must be called with "

@@ -162,6 +162,41 @@ class Config:
     # keeps its own dedupe, so this only saves wire frames + head-loop
     # wakeups; <= 0 restores the hint-per-batch behavior.
     prefetch_hint_dedupe_ttl_s: float = 5.0
+    # PREFETCH_HINT coalescing (r15): dedupe catches REPEATED arg ids,
+    # but a pipeline hot loop ships FRESH by-ref args every call (each
+    # microbatch's activation is a new object) — one hint frame per
+    # pushed batch per stage actor. With coalescing on, hints buffer
+    # per (lease | actor) destination and the submitter's next wakeup
+    # flushes everything pending as ONE PREFETCH_HINT_BATCH frame
+    # (destinations ride together; ids hinted to the same destination
+    # across consecutive batches merge — counted in the context's
+    # ``prefetch_hints_coalesced``). Latency cost is one submitter
+    # wakeup (~sub-ms), irrelevant to speculation that exists to
+    # overlap a multi-ms transfer. False restores the r14
+    # frame-per-batch behavior (the A/B control).
+    prefetch_hint_coalesce: bool = True
+
+    # --- MPMD pipeline parallelism (r15) ---
+    # Stage-actor placement for ``train.pipeline.Pipeline``:
+    # "auto" pins stage k to node (k mod n_alive_nodes) with soft node
+    # affinity — one stage per node when the cluster has enough nodes,
+    # so activations flow store-to-store over the object plane and each
+    # stage's compute overlaps its neighbours' transfers; "spread" uses
+    # a SPREAD placement group (the reference's pipeline-stage
+    # placement-group idiom) without explicit node pinning; "none"
+    # leaves placement to the default hybrid policy (stages may
+    # co-locate — correct, but transfer/compute overlap vanishes).
+    pipeline_stage_placement: str = "auto"
+    # Upper bound on microbatches in flight per ``run_batch``. 0 = the
+    # schedule's natural bound: 1F1B is self-limiting at O(stages)
+    # in-flight (stage k holds at most S-k live activation contexts)
+    # while GPipe keeps all M alive until its backward wave. A positive
+    # value runs the batch in WAVES of at most this many microbatches —
+    # grads keep accumulating across waves so results are unchanged,
+    # each wave boundary drains the pipeline (one extra bubble per
+    # wave) — useful to cap arena footprint when running GPipe with
+    # many microbatches.
+    pipeline_max_inflight_microbatches: int = 0
 
     # --- serve at scale (r14) ---
     # How long a ``slow_node`` detector flag stays routable-around: the
@@ -407,5 +442,18 @@ def get_config() -> Config:
 
 
 def reset_config():
+    """Reset the singleton to defaults (+ env overrides).
+
+    IN PLACE when a singleton already exists (r15): ``init()`` resets the
+    config before applying ``_system_config``, and a module that grabbed
+    ``get_config()`` BEFORE ``init()`` used to keep an orphaned object —
+    its reads went stale and its mutations (e.g. a bench A/B toggling a
+    flag) silently never reached the live runtime. Re-initializing the
+    existing instance keeps every reference, whenever taken, pointing at
+    the one live config."""
     global _config
-    _config = None
+    if _config is None:
+        return
+    fresh = Config()
+    for f in fields(_config):
+        setattr(_config, f.name, getattr(fresh, f.name))

@@ -276,9 +276,16 @@ class CoreContext:
         self._known_owners: Dict[ObjectID, str] = {}
         self._dep_unready: set = set()  # actor tasks awaiting arg resolution
         # PREFETCH_HINT accounting (r14): frames actually sent vs arg
-        # ids suppressed by the per-lease/per-actor dedupe window
+        # ids suppressed by the per-lease/per-actor dedupe window;
+        # r15 adds coalescing — hints buffer per destination key and
+        # flush from the submitter loop as ONE frame, so a pipeline hot
+        # loop pushing fresh per-microbatch refs doesn't emit a frame
+        # per pushed batch. prefetch_hints_coalesced counts the frames
+        # saved (hint batches merged into an already-pending flush).
         self.prefetch_hints_sent = 0
         self.prefetch_hints_suppressed = 0
+        self.prefetch_hints_coalesced = 0
+        self._hint_buf: "OrderedDict[str, list]" = OrderedDict()
         self._hint_lock = threading.Lock()
         self._sub_lock = threading.RLock()
         self._submit_event = threading.Event()
@@ -996,6 +1003,7 @@ class CoreContext:
                     classes = list(self._classes.items())
                 for cls, st in classes:
                     self._drain_class(cls, st)
+                self._flush_prefetch_hints()
                 self._reap_idle_leases()
                 self._flush_frees()
             except Exception:
@@ -1157,12 +1165,53 @@ class CoreContext:
                 self.prefetch_hints_suppressed += n_in - len(ids)
             if not ids:
                 return
+        if cfg.prefetch_hint_coalesce:
+            # r15: buffer per destination; the submitter loop's next
+            # wakeup flushes EVERYTHING pending as one frame
+            # (_flush_prefetch_hints). A batch landing on a key that
+            # already has a pending flush merges into it — that is one
+            # whole frame saved, counted in prefetch_hints_coalesced.
+            with self._hint_lock:
+                buf = self._hint_buf.get(lease_key)
+                if buf is None:
+                    self._hint_buf[lease_key] = list(ids)
+                else:
+                    self.prefetch_hints_coalesced += 1
+                    seen = set(buf)
+                    buf.extend(ab for ab in ids if ab not in seen)
+            self._submit_event.set()
+            return
         with self._hint_lock:
             self.prefetch_hints_sent += 1
         try:
             self.head.send(P.PREFETCH_HINT, lease_key, ids)
         except P.ConnectionLost:
             pass  # speculation only: the demand path still works
+
+    def _flush_prefetch_hints(self):
+        """Ship every buffered prefetch hint in ONE frame (r15 hint
+        coalescing). Driven by the submitter loop — each submit wakes
+        it, so the added latency is one thread wakeup, paid only by
+        speculation whose whole point is overlapping multi-ms
+        transfers. Single-destination flushes reuse the plain
+        PREFETCH_HINT frame so an r14 head decodes them unchanged."""
+        with self._hint_lock:
+            if not self._hint_buf:
+                return
+            entries = list(self._hint_buf.items())
+            self._hint_buf.clear()
+        if not self.head.is_attached():
+            return  # head outage: drop — demand path still works
+        try:
+            if len(entries) == 1:
+                self.head.send(P.PREFETCH_HINT, entries[0][0],
+                               entries[0][1])
+            else:
+                self.head.send(P.PREFETCH_HINT_BATCH, entries)
+        except P.ConnectionLost:
+            return  # dropped, not sent
+        with self._hint_lock:
+            self.prefetch_hints_sent += 1
 
     def _request_lease(self, cls, st: _ClassState):
         from .serialization import dumps
@@ -1504,13 +1553,20 @@ class CoreContext:
             return st
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args,
-                          kwargs, *, num_returns=1, max_retries=0
-                          ) -> List[ObjectRef]:
+                          kwargs, *, num_returns=1, max_retries=0,
+                          name: str = "") -> List[ObjectRef]:
+        """``name`` overrides the task's observability label (defaults
+        to the method name): the func key under which the r10 phase
+        histograms, straggler detector and `summary tasks` aggregate
+        this call. Pipeline stage actors use it (``stage{k}.fwd``) so
+        per-stage bubble/transfer time is separable with no new
+        plumbing."""
         st = self._actor_state(actor_id)
         task_id = TaskID.for_actor_task(actor_id)
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id, task_type=TaskType.ACTOR_TASK,
-            name=method_name, function_id="", method_name=method_name,
+            name=name or method_name, function_id="",
+            method_name=method_name,
             num_returns=num_returns, owner=self.worker_id,
             actor_id=actor_id, max_retries=max_retries,
             trace_ctx=task_events.submit_trace_ctx(),

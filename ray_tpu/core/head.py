@@ -2627,6 +2627,16 @@ class Head:
             return  # lease already returned: nothing to speculate for
         self._maybe_prefetch_args(lease_id, lease[0], arg_bins)
 
+    def _h_prefetch_hint_batch(self, conn, rid, entries):
+        """PREFETCH_HINT_BATCH (r15): one frame carrying every hint a
+        driver buffered since its last submitter wakeup — a pipeline
+        hot loop's per-microbatch activations arrive as one frame per
+        tick instead of one per pushed batch. Each (lease_key, ids)
+        entry takes the exact single-hint path (actor resolution,
+        caps, holder checks, dedupe)."""
+        for lease_key, arg_bins in entries:
+            self._h_prefetch_hint(conn, 0, lease_key, arg_bins)
+
     def _actor_node_idx(self, actor_hex: str) -> Optional[int]:
         """Node currently hosting an actor's worker (None when the
         actor is dead/unknown/not yet placed)."""
@@ -4052,6 +4062,7 @@ class Head:
         P.XLANG_CALL: _h_xlang_call,
         P.PREFETCH_RESULT: _h_prefetch_result,
         P.PREFETCH_HINT: _h_prefetch_hint,
+        P.PREFETCH_HINT_BATCH: _h_prefetch_hint_batch,
         P.OBJECT_WARM: _h_object_warm,
     }
 

@@ -195,6 +195,18 @@ PREFETCH_HINT = 78      # driver->head, one-way: (lease_id,
 #                         the same holder check / caps / dedupe and
 #                         fires prefetch-flagged PULL_OBJECTs while the
 #                         batch is still in flight to the worker.
+PREFETCH_HINT_BATCH = 80  # driver->head, one-way: ([(lease_key,
+#                         [arg_id_bins])],) — r15 coalesced form of
+#                         PREFETCH_HINT: a pipeline/actor hot loop
+#                         pushing many small batches with FRESH by-ref
+#                         args (per-microbatch activations defeat the
+#                         r14 dedupe window — every id is novel) buffers
+#                         hints per (lease | actor:<hex>) destination
+#                         and the submitter's next wakeup ships ALL
+#                         pending destinations in this one frame instead
+#                         of one frame per pushed batch. The head
+#                         unrolls it through the PREFETCH_HINT path
+#                         (same caps / holder checks / dedupe).
 OBJECT_WARM = 79        # client->head: (oid_bin, node_idx) — warm an
 #                         object onto a node BEFORE any task/actor that
 #                         needs it is even placed (r14 serve cold-start:

@@ -103,7 +103,11 @@ class _Handler(BaseHTTPRequestHandler):
                 kind = path[len("/api/summary/"):]
                 fn = {"tasks": state.summarize_tasks,
                       "actors": state.summarize_actors,
-                      "objects": state.summarize_objects}.get(kind)
+                      "objects": state.summarize_objects,
+                      # per-pipeline-stage bubble/transfer/exec view
+                      # (r15) — same head data as summary/tasks, keyed
+                      # stage{k}.fwd/bwd and split per stage
+                      "pipeline": state.pipeline_stage_summary}.get(kind)
                 if fn is None:
                     self._json({"error": f"unknown summary {kind}"}, 404)
                 else:
@@ -207,6 +211,7 @@ DOCTOR_ENDPOINTS = (
     "/api/io_loop", "/api/object_plane", "/api/cluster_events",
     "/api/metrics", "/api/jobs", "/api/timeline",
     "/api/summary/tasks", "/api/summary/actors", "/api/summary/objects",
+    "/api/summary/pipeline",
     "/api/serve/applications",
     "/metrics",
 )

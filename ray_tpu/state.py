@@ -165,6 +165,53 @@ def phase_summary(funcs: Optional[Sequence[str]] = None
     return rows[0] if rows else {}
 
 
+def pipeline_stage_summary(prefix: Optional[str] = None
+                           ) -> Dict[int, Dict[str, Any]]:
+    """Per-pipeline-stage bubble/transfer/compute split (r15), derived
+    from the same func-scoped phase histograms as ``phase_summary`` —
+    stage actors submit their ops as ``{name_prefix}stage{k}.fwd`` /
+    ``.bwd``, so no new head plumbing exists behind this. Returns
+    ``{stage_idx: {"fwd": {...}, "bwd": {...}, "bubble_ms_p95",
+    "transfer_ms_p95", "exec_ms_p95"}}`` where bubble = sched_wait (the
+    stage sat idle waiting for work), transfer = arg_fetch (activation
+    pull not hidden under compute) and exec = compute, each the p95 over
+    that stage's ops — the per-stage attribution the MPMD paper's
+    hand-rolled systems lack.
+
+    ``prefix`` selects one ``Pipeline.name_prefix`` exactly (``""`` for
+    unprefixed). Default ``None`` matches any prefix; when several
+    pipelines ran under different prefixes, each (stage, op) slot keeps
+    the variant with the most completed ops (pass ``prefix=`` to
+    disambiguate an A/B explicitly)."""
+    import re
+
+    rows = phase_summary()
+    stages: Dict[int, Dict[str, Any]] = {}
+    pat = re.compile(r"^(.*?)stage(\d+)\.(fwd|bwd)$")
+
+    def _n(phases):
+        return phases.get("exec", {}).get("count", 0)
+
+    for func, phases in rows.items():
+        m = pat.match(func)
+        if not m:
+            continue
+        pfx, k, op = m.group(1), int(m.group(2)), m.group(3)
+        if prefix is not None and pfx != prefix:
+            continue
+        slot = stages.setdefault(k, {})
+        if op not in slot or _n(phases) > _n(slot[op]):
+            slot[op] = phases
+    for k, d in stages.items():
+        for metric, phase in (("bubble_ms_p95", "sched_wait"),
+                              ("transfer_ms_p95", "arg_fetch"),
+                              ("exec_ms_p95", "exec")):
+            d[metric] = max((d[op].get(phase, {}).get("p95_ms", 0.0)
+                             for op in ("fwd", "bwd") if op in d),
+                            default=0.0)
+    return stages
+
+
 def summarize_actors(limit: int = 10_000) -> Dict[str, Any]:
     rows = list_actors(limit=limit)
     states = Counter(r["state"] for r in rows)

@@ -34,6 +34,16 @@ from ray_tpu.train.trainer import (
     JaxTrainer,
 )
 from ray_tpu.train.worker_group import RayTrainWorker, WorkerGroup
+from ray_tpu.train.pipeline import (
+    Pipeline,
+    PipelineStage,
+    SingleProgramPipeline,
+    single_program_reference,
+)
+from ray_tpu.train.pipeline_schedules import (
+    gpipe_order,
+    one_f_one_b_order,
+)
 
 __all__ = [
     "ScalingConfig", "RunConfig", "CheckpointConfig", "FailureConfig",
@@ -42,6 +52,8 @@ __all__ = [
     "BackendExecutor", "TrainingWorkerError",
     "BaseTrainer", "DataParallelTrainer", "JaxTrainer",
     "WorkerGroup", "RayTrainWorker",
+    "Pipeline", "PipelineStage", "SingleProgramPipeline",
+    "single_program_reference", "gpipe_order", "one_f_one_b_order",
     "report", "get_checkpoint", "get_context", "get_dataset_shard",
     "get_world_rank", "get_world_size", "get_local_rank", "TrainContext",
 ]

@@ -1,0 +1,550 @@
+"""MPMD pipeline parallelism on the object plane (r15).
+
+Ref analog: "Scaling Deep Learning Training with MPMD Pipeline
+Parallelism" (PAPERS.md) — pipeline stages as separate programs on
+separate slices, activations flowing between them. Here each stage is
+one actor, gang-placed one-per-node when the cluster allows, and the
+schedule (GPipe or 1F1B, ``pipeline_schedules.py``) is expressed as a
+plain task graph over those actors:
+
+- **intra-stage order** rides per-actor task seqno order — submitting a
+  stage's ops in schedule order IS the stage's local program;
+- **inter-stage handoff** rides the object plane: a stage's forward
+  returns its activation as a plasma-resident ``jax.Array`` payload
+  (the r13 typed zero-copy reducer) on the stage's own node, the driver
+  passes only the ``ObjectRef``, and the consuming stage's arg fetch
+  pulls it store-to-store — the driver never touches activation bytes;
+- **handoff overlap** (the perf core): pushing the consuming task fires
+  a dispatch-time ``PREFETCH_HINT`` naming the consumer's node, so the
+  activation pull starts while the consumer is still busy with the
+  previous microbatch — the transfer hides under compute instead of
+  serializing in front of it. Pipeline hot loops ship fresh refs every
+  microbatch, so hints are COALESCED per destination across submit
+  batches into one ``PREFETCH_HINT_BATCH`` frame per submitter wakeup
+  (``prefetch_hint_coalesce``);
+- **eager activation free**: every activation has exactly one consumer;
+  the driver drops its handle the moment the consumer is submitted, so
+  the owner free (consumer completion + borrow grace) deletes the
+  store copy promptly and 1F1B's steady-state arena footprint stays
+  O(stages), not O(microbatches);
+- **bubble attribution comes free** from the r10 phase timelines: stage
+  ops are submitted under per-stage func names (``stage{k}.fwd`` /
+  ``stage{k}.bwd``), so ``summary tasks`` / ``state.phase_summary``
+  split each stage's sched_wait (bubble) from arg_fetch (transfer) from
+  exec (compute), and a deliberately slow stage trips the existing
+  straggler detector under its own name.
+
+The SPMD cousin ``parallel/pipeline.py`` pipelines inside one XLA
+program over the ``pipeline`` mesh axis; this module is the
+multi-program face for stages too big or too heterogeneous to live in
+one program (or one cluster node).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import ray_tpu
+from ray_tpu.core.api import NodeAffinitySchedulingStrategy, \
+    PlacementGroupSchedulingStrategy
+from ray_tpu.core.config import get_config
+from ray_tpu.train.pipeline_schedules import SCHEDULES, validate_order
+
+
+@dataclass
+class PipelineStage:
+    """One stage's program. Two modes:
+
+    - **jax mode** (``fn``): ``fn(params, x) -> y`` must be
+      jax-differentiable; forward runs ``jax.vjp`` and saves the pullback
+      actor-locally per microbatch, backward applies it and accumulates
+      parameter cotangents. The LAST stage composes ``loss_fn(y, target)``
+      so its forward returns the (scalar) per-microbatch loss.
+    - **raw mode** (``fwd``/``bwd``): ``fwd(params, x) -> (y, saved)``
+      and ``bwd(params, saved, g) -> (dparams, dx)`` — arbitrary Python
+      (benchmarks pace compute with sleeps; a hand-written backward
+      schedule fits here too). ``g`` is None for the last stage.
+    """
+
+    fn: Optional[Callable] = None
+    params: Any = None
+    fwd: Optional[Callable] = None
+    bwd: Optional[Callable] = None
+
+    def __post_init__(self):
+        if (self.fn is None) == (self.fwd is None):
+            raise ValueError(
+                "PipelineStage needs exactly one of fn= (jax mode) or "
+                "fwd=/bwd= (raw mode)")
+        if self.fwd is not None and self.bwd is None:
+            raise ValueError("raw mode needs both fwd= and bwd=")
+
+
+class _StageWorker:
+    """Actor hosting one stage: params + per-microbatch saved contexts
+    + accumulated grads. Stateless across batches once ``reset()``."""
+
+    def __init__(self, stage_idx: int, num_stages: int,
+                 stage: PipelineStage, loss_fn=None):
+        self.k = stage_idx
+        self.S = num_stages
+        self._stage = stage
+        self._loss_fn = loss_fn
+        self._ctx: Dict[int, Any] = {}
+        self._gsum = None
+        self._nmb = 0
+        self._delay_fwd_s = 0.0
+        self._delay_only_mb: Optional[int] = None
+
+    # -------------------------------------------------- chaos / tests
+
+    def set_delay(self, fwd_s: float, only_mb: Optional[int] = None):
+        """Deliberately slow this stage's forward (straggler-detector
+        validation): every microbatch, or just ``only_mb``."""
+        self._delay_fwd_s = fwd_s
+        self._delay_only_mb = only_mb
+        return True
+
+    def probe(self) -> dict:
+        from ray_tpu.core.context import get_context as _gc
+
+        return {"stage": self.k, "node_idx": _gc().node_idx,
+                "live_contexts": len(self._ctx)}
+
+    def reset(self):
+        self._ctx.clear()
+        self._gsum = None
+        self._nmb = 0
+        return True
+
+    # -------------------------------------------------- schedule ops
+
+    def fwd(self, x, mb: int, target=None):
+        if self._delay_fwd_s and (self._delay_only_mb is None
+                                  or self._delay_only_mb == mb):
+            time.sleep(self._delay_fwd_s)
+        st = self._stage
+        if st.fn is None:
+            y, saved = st.fwd(st.params, x)
+            self._ctx[mb] = saved
+            return y
+        import jax
+
+        last = self.k == self.S - 1
+        if last and self._loss_fn is not None:
+            loss_fn = self._loss_fn
+
+            def f(p, a):
+                return loss_fn(st.fn(p, a), target)
+
+            y, pullback = jax.vjp(f, st.params, x)
+        else:
+            y, pullback = jax.vjp(st.fn, st.params, x)
+        self._ctx[mb] = pullback
+        return y
+
+    def bwd(self, g, mb: int):
+        st = self._stage
+        saved = self._ctx.pop(mb)
+        if st.fn is None:
+            dp, dx = st.bwd(st.params, saved, g)
+        else:
+            import jax.numpy as jnp
+
+            if g is None:  # last stage: seed the scalar loss
+                g = jnp.asarray(1.0)
+            dp, dx = saved(g)
+            del saved
+        if dp is not None:
+            self._gsum = dp if self._gsum is None else _tree_add(
+                self._gsum, dp)
+        self._nmb += 1
+        return dx if self.k > 0 else None
+
+    def grads(self, mean: bool = True):
+        """Accumulated parameter cotangents (mean over microbatches by
+        default — matches a full-batch mean loss when microbatches are
+        equal-sized and the per-microbatch loss is itself a mean)."""
+        if self._gsum is None or not self._nmb:
+            return None
+        if not mean:
+            return self._gsum
+        import jax
+
+        n = self._nmb
+        return jax.tree_util.tree_map(lambda a: a / n, self._gsum)
+
+
+def _tree_add(a, b):
+    import jax
+
+    return jax.tree_util.tree_map(lambda x, y: x + y, a, b)
+
+
+def _uniform_mode(stages: Sequence[PipelineStage]) -> bool:
+    """All stages must share one mode — loss composition happens on the
+    LAST stage while driver-side loss resolution keys off the batch's
+    mode, so a mixed list would silently drop the loss (or crash at
+    batch end). Returns True for jax mode."""
+    if not stages:
+        raise ValueError("need at least one PipelineStage")
+    modes = {st.fn is not None for st in stages}
+    if len(modes) > 1:
+        raise ValueError(
+            "all PipelineStages must share one mode (every stage fn=, "
+            "or every stage fwd=/bwd=)")
+    return modes.pop()
+
+
+def _check_targets(targets, jax_mode: bool, loss_fn) -> None:
+    """Targets only reach the loss via the jax-mode last-stage
+    ``loss_fn`` composition; anywhere else they'd be silently ignored."""
+    if targets is None:
+        return
+    if not jax_mode:
+        raise ValueError(
+            "targets= requires jax-mode stages (raw fwd(params, x) "
+            "cannot receive a target; fold labels into the microbatch)")
+    if loss_fn is None:
+        raise ValueError("targets= requires loss_fn=")
+
+
+def _check_batch(microbatches, targets, jax_mode: bool,
+                 loss_fn) -> list:
+    """Shared run_batch input validation (Pipeline AND the
+    SingleProgramPipeline baseline must reject identically — a baseline
+    that zip-truncates a mismatched batch compares a different
+    workload). Returns the per-microbatch target list."""
+    if not len(microbatches):
+        raise ValueError("need at least one microbatch")
+    _check_targets(targets, jax_mode, loss_fn)
+    if targets is not None and len(targets) != len(microbatches):
+        raise ValueError("len(targets) != len(microbatches)")
+    return (list(targets) if targets is not None
+            else [None] * len(microbatches))
+
+
+class Pipeline:
+    """Driver handle: builds the stage gang, runs schedules.
+
+    ``placement`` (default: config ``pipeline_stage_placement``):
+    ``"auto"`` pins stage k to alive node (k mod n) with soft node
+    affinity — one stage per node when the cluster has at least as many
+    nodes as stages; ``"spread"`` uses a SPREAD placement group;
+    ``"none"`` leaves it to the default policy."""
+
+    def __init__(self, stages: Sequence[PipelineStage], *,
+                 loss_fn: Optional[Callable] = None,
+                 schedule: str = "1f1b",
+                 placement: Optional[str] = None,
+                 num_cpus_per_stage: int = 1,
+                 max_inflight_microbatches: Optional[int] = None,
+                 pg_timeout_s: float = 60.0,
+                 name_prefix: str = ""):
+        #: prepended to the per-stage task names (``stage{k}.fwd`` ->
+        #: ``{prefix}stage{k}.fwd``); mutable between batches — A/B
+        #: benches retag rounds so the cumulative phase histograms
+        #: stay separable per round
+        self.name_prefix = name_prefix
+        if schedule not in SCHEDULES:
+            raise ValueError(f"unknown schedule {schedule!r} "
+                             f"(have {sorted(SCHEDULES)})")
+        cfg = get_config()
+        self.num_stages = len(stages)
+        self.schedule = schedule
+        self._loss_fn = loss_fn
+        self._jax_mode = _uniform_mode(stages)
+        self._bound = (cfg.pipeline_max_inflight_microbatches
+                       if max_inflight_microbatches is None
+                       else max_inflight_microbatches)
+        self._pg = None
+        strategies = self._resolve_placement(
+            placement or cfg.pipeline_stage_placement,
+            num_cpus_per_stage, pg_timeout_s)
+        actor_cls = ray_tpu.remote(_StageWorker)
+        self.actors = []
+        for k, stage in enumerate(stages):
+            opts = {"num_cpus": num_cpus_per_stage}
+            if strategies[k] is not None:
+                opts["scheduling_strategy"] = strategies[k]
+            self.actors.append(actor_cls.options(**opts).remote(
+                k, self.num_stages, stage,
+                loss_fn if k == self.num_stages - 1 else None))
+
+    def _resolve_placement(self, mode: str, num_cpus: int,
+                           pg_timeout_s: float) -> list:
+        S = self.num_stages
+        if mode == "auto":
+            alive = sorted(n["node_idx"] for n in ray_tpu.nodes()
+                           if n.get("alive"))
+            if len(alive) <= 1:
+                return [None] * S
+            # soft pinning: a stage whose node fills up may still land
+            # elsewhere rather than wedging the gang
+            return [NodeAffinitySchedulingStrategy(
+                alive[k % len(alive)], soft=True) for k in range(S)]
+        if mode == "spread":
+            self._pg = ray_tpu.placement_group(
+                [{"CPU": num_cpus}] * S, strategy="SPREAD")
+            if not self._pg.ready(timeout=pg_timeout_s):
+                raise TimeoutError(
+                    f"SPREAD placement group for {S} stages not ready "
+                    f"after {pg_timeout_s}s")
+            return [PlacementGroupSchedulingStrategy(self._pg, k)
+                    for k in range(S)]
+        if mode != "none":
+            raise ValueError(
+                f"unknown placement {mode!r} (have auto/spread/none)")
+        return [None] * S
+
+    # ------------------------------------------------------ execution
+
+    def run_batch(self, microbatches: Sequence[Any],
+                  targets: Optional[Sequence[Any]] = None, *,
+                  by_ref_min_bytes: int = 1 << 20) -> dict:
+        """Run one optimizer batch of ``len(microbatches)`` microbatches
+        through the configured schedule. Inputs (and jax-mode targets)
+        may be values or ``ObjectRef``s; values of at least
+        ``by_ref_min_bytes`` are ``put()`` so stage 0 pulls them by-ref.
+
+        Returns ``{"loss", "per_mb_losses", "outputs"}`` — ``loss`` is
+        the mean per-microbatch loss in jax mode (None in raw mode);
+        ``outputs`` are the last stage's forward results (loss refs in
+        jax mode, raw forwards' returns otherwise), already resolved
+        for jax mode."""
+        tgts = _check_batch(microbatches, targets, self._jax_mode,
+                            self._loss_fn)
+        M = len(microbatches)
+        out_refs: List[Any] = []
+        bound = self._bound
+        wave = M if bound <= 0 else min(bound, M)
+        # a positive bound runs the batch in WAVES of at most `bound`
+        # microbatches — at no point are more than `bound` in flight
+        # (grads keep accumulating across waves, so results are
+        # unchanged; each wave boundary drains the pipeline)
+        for off in range(0, M, wave):
+            out_refs.extend(self._run_wave(
+                microbatches[off:off + wave], tgts[off:off + wave],
+                off, by_ref_min_bytes))
+        result = {"loss": None, "per_mb_losses": None,
+                  "outputs": out_refs}
+        if self._jax_mode and self._loss_fn is not None:
+            losses = [float(v) for v in ray_tpu.get(out_refs,
+                                                    timeout=600)]
+            result["per_mb_losses"] = losses
+            result["loss"] = sum(losses) / len(losses)
+        return result
+
+    def _run_wave(self, microbatches, tgts, mb_offset: int,
+                  by_ref_min_bytes: int) -> list:
+        S, M = self.num_stages, len(microbatches)
+        orders = SCHEDULES[self.schedule](S, M)
+        validate_order(orders)
+        inputs: List[Any] = [self._maybe_put(x, by_ref_min_bytes)
+                             for x in microbatches]
+        # live refs, popped the moment their single consumer is
+        # submitted (eager activation free: the owner free fires at
+        # consumer completion instead of batch end)
+        F: Dict[tuple, Any] = {}
+        G: Dict[tuple, Any] = {}
+        f_done: set = set()
+        g_done: set = set()
+        b0_refs: Dict[int, Any] = {}  # stage-0 backwards: wave barrier
+        out_refs: List[Any] = [None] * M
+        idx = [0] * S
+        total = sum(len(o) for o in orders)
+        submitted = 0
+        while submitted < total:
+            progressed = False
+            for k in range(S):
+                actor = self.actors[k]
+                while idx[k] < len(orders[k]):
+                    op, mb = orders[k][idx[k]]
+                    if op == "F":
+                        if k == 0:
+                            x = inputs[mb]
+                            inputs[mb] = None  # driver handle dropped
+                        else:
+                            if (k - 1, mb) not in f_done:
+                                break
+                            x = F.pop((k - 1, mb))
+                        kwargs = {}
+                        if k == S - 1 and tgts[mb] is not None:
+                            kwargs["target"] = tgts[mb]
+                        ref = actor.fwd.options(
+                            name=f"{self.name_prefix}stage{k}.fwd"
+                        ).remote(x, mb_offset + mb, **kwargs)
+                        del x
+                        f_done.add((k, mb))
+                        if k == S - 1:
+                            out_refs[mb] = ref
+                        else:
+                            F[(k, mb)] = ref
+                    else:  # "B"
+                        if k == S - 1:
+                            g = None
+                        else:
+                            if (k + 1, mb) not in g_done:
+                                break
+                            g = G.pop((k + 1, mb))
+                        ref = actor.bwd.options(
+                            name=f"{self.name_prefix}stage{k}.bwd"
+                        ).remote(g, mb_offset + mb)
+                        del g
+                        g_done.add((k, mb))
+                        if k == 0:
+                            b0_refs[mb] = ref
+                        else:
+                            G[(k, mb)] = ref
+                    idx[k] += 1
+                    submitted += 1
+                    progressed = True
+            if not progressed:  # pragma: no cover — validate_order gates
+                raise RuntimeError("pipeline submission wedged")
+        # barrier: the wave is done when every microbatch's stage-0
+        # backward (the tail of its dependency chain) has completed
+        ray_tpu.get(list(b0_refs.values()), timeout=600)
+        return out_refs
+
+    @staticmethod
+    def _maybe_put(x, min_bytes: int):
+        from ray_tpu.core.object_ref import ObjectRef
+
+        if isinstance(x, ObjectRef):
+            return x
+        if min_bytes > 0 and getattr(x, "nbytes", 0) >= min_bytes:
+            return ray_tpu.put(x)
+        return x
+
+    # ---------------------------------------------------- gang state
+
+    def grads(self, mean: bool = True) -> list:
+        """Per-stage accumulated parameter grads (driver-fetched)."""
+        return ray_tpu.get([a.grads.remote(mean) for a in self.actors],
+                           timeout=600)
+
+    def reset(self):
+        ray_tpu.get([a.reset.remote() for a in self.actors], timeout=60)
+
+    def probe(self) -> list:
+        """Per-stage {stage, node_idx, live_contexts} (tests/debug)."""
+        return ray_tpu.get([a.probe.remote() for a in self.actors],
+                           timeout=60)
+
+    def shutdown(self):
+        for a in self.actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+        self.actors = []
+        if self._pg is not None:
+            try:
+                ray_tpu.remove_placement_group(self._pg)
+            except Exception:  # noqa: BLE001
+                pass
+            self._pg = None
+
+
+class SingleProgramPipeline:
+    """The sequential baseline: the SAME stages composed into one
+    program on one actor — per microbatch, forward through every stage
+    then backward through every stage, no cross-node handoff, no
+    overlap. The bench's A and the numerical-equivalence oracle's
+    cluster leg."""
+
+    def __init__(self, stages: Sequence[PipelineStage], *,
+                 loss_fn: Optional[Callable] = None,
+                 num_cpus: int = 1, scheduling_strategy=None):
+        self.num_stages = len(stages)
+        self._jax_mode = stages[0].fn is not None
+        self._loss_fn = loss_fn
+        opts = {"num_cpus": num_cpus}
+        if scheduling_strategy is not None:
+            opts["scheduling_strategy"] = scheduling_strategy
+        self._actor = ray_tpu.remote(_SingleProgramWorker).options(
+            **opts).remote(list(stages), loss_fn)
+
+    def run_batch(self, microbatches: Sequence[Any],
+                  targets: Optional[Sequence[Any]] = None, *,
+                  by_ref_min_bytes: int = 1 << 20) -> dict:
+        tgts = _check_batch(microbatches, targets, self._jax_mode,
+                            self._loss_fn)
+        refs = [self._actor.step.options(name="single_program.step")
+                .remote(Pipeline._maybe_put(x, by_ref_min_bytes), t, mb)
+                for mb, (x, t) in enumerate(zip(microbatches, tgts))]
+        outs = ray_tpu.get(refs, timeout=600)
+        result = {"loss": None, "per_mb_losses": None, "outputs": outs}
+        if self._jax_mode and self._loss_fn is not None:
+            losses = [float(v) for v in outs]
+            result["per_mb_losses"] = losses
+            result["loss"] = sum(losses) / len(losses)
+        return result
+
+    def grads(self, mean: bool = True) -> list:
+        return ray_tpu.get(self._actor.grads.remote(mean), timeout=600)
+
+    def reset(self):
+        ray_tpu.get([self._actor.reset.remote()], timeout=60)
+
+    def shutdown(self):
+        try:
+            ray_tpu.kill(self._actor)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class _SingleProgramWorker:
+    def __init__(self, stages: List[PipelineStage], loss_fn):
+        self._workers = [
+            _StageWorker(k, len(stages), st,
+                         loss_fn if k == len(stages) - 1 else None)
+            for k, st in enumerate(stages)]
+
+    def step(self, x, target, mb: int):
+        n = len(self._workers)
+        for k, w in enumerate(self._workers):
+            x = w.fwd(x, mb, target=target if k == n - 1 else None)
+        out = x
+        g = None
+        for w in reversed(self._workers):
+            g = w.bwd(g, mb)
+        return out
+
+    def grads(self, mean: bool = True):
+        return [w.grads(mean) for w in self._workers]
+
+    def reset(self):
+        for w in self._workers:
+            w.reset()
+        return True
+
+
+def single_program_reference(stages: Sequence[PipelineStage], loss_fn,
+                             microbatches: Sequence[Any],
+                             targets: Sequence[Any]):
+    """Driver-side oracle (no cluster): compose the jax-mode stage fns
+    into one function, ``jax.value_and_grad`` it per microbatch, and
+    average — the number the pipeline must reproduce. Returns
+    ``(mean_loss, [per-stage mean grads])``."""
+    import jax
+
+    params = [st.params for st in stages]
+
+    def composed(ps, x, t):
+        for st, p in zip(stages[:-1], ps[:-1]):
+            x = st.fn(p, x)
+        return loss_fn(stages[-1].fn(ps[-1], x), t)
+
+    vg = jax.value_and_grad(composed)
+    loss_sum = 0.0
+    gsum = None
+    for x, t in zip(microbatches, targets):
+        loss, g = vg(params, x, t)
+        loss_sum += float(loss)
+        gsum = g if gsum is None else jax.tree_util.tree_map(
+            lambda a, b: a + b, gsum, g)
+    n = len(microbatches)
+    return loss_sum / n, jax.tree_util.tree_map(lambda a: a / n, gsum)
