@@ -27,8 +27,22 @@ the pipeline exists to overlap.
    name_prefix`` so the cumulative phase histograms stay separable.
    Gates: median p95 reduction >= 30%, prefetch_wasted < 10% of issued.
 
-Run: python bench_pipeline.py [--pairs 3] [--phases schedule,hints]
-     [--out BENCH_pipeline_r15.json]
+3. **chaos** (r16, -> ``--repair-out`` BENCH_repair_r16.json) —
+   4-stage x 8-microbatch 1F1B with wave-boundary stage checkpoints;
+   kill -9 of a mid-pipeline stage's agent node mid-batch. Gates: the
+   job completes with losses/grads NUMERICALLY EQUAL to the no-fault
+   driver-side oracle, ``repair_redo_microbatches`` <= one wave, and
+   repaired wall clock <= 2x the no-fault run.
+
+4. **drain** (r16, same artifact) — graceful ``drain_node`` of a node
+   hosting a live stage mid-batch. Gates: zero failed tasks (the stage
+   migrates at a wave boundary BEFORE the shutdown),
+   ``drain_migrated_leases`` >= 1, grads equal the oracle, and the
+   drained node's object copies remain fetchable from survivors.
+
+Run: python bench_pipeline.py [--pairs 3]
+     [--phases schedule,hints,chaos,drain]
+     [--out BENCH_pipeline_r15.json] [--repair-out BENCH_repair_r16.json]
 """
 
 import argparse
@@ -374,12 +388,284 @@ def bench_hints(pairs: int) -> dict:
     }
 
 
+# ------------------------------------------------- chaos / drain (r16)
+
+
+CKPT_D = 192  # param dim: 192x192 f32 weights (~147 KiB) keep stage
+#               snapshots ABOVE the inline cap, so checkpoints ride the
+#               object plane and the off-node replication path is real
+
+
+def _mk_ckpt_jax_stages(n_stages, fwd_sleep_s, seed=0):
+    """jax-mode stages big enough that snapshots are plasma-resident;
+    forward paced with a sleep (executes during the vjp trace)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.train.pipeline import PipelineStage
+
+    rng = np.random.default_rng(seed)
+
+    def fn(p, x):
+        if fwd_sleep_s:
+            time.sleep(fwd_sleep_s)
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    stages = [
+        PipelineStage(fn=fn, params={
+            "w": jnp.asarray(
+                rng.normal(size=(CKPT_D, CKPT_D)).astype(np.float32)
+                * 0.05),
+            "b": jnp.asarray(
+                rng.normal(size=(CKPT_D,)).astype(np.float32))})
+        for _ in range(n_stages)]
+
+    def loss_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    mbs = [jnp.asarray(
+        rng.normal(size=(4, CKPT_D)).astype(np.float32))
+        for _ in range(MICRO)]
+    tgts = [jnp.asarray(
+        rng.normal(size=(4, CKPT_D)).astype(np.float32))
+        for _ in range(MICRO)]
+    return stages, loss_fn, mbs, tgts
+
+
+def _tree_max_err(a, b):
+    import jax
+    import numpy as np
+
+    return max(float(np.max(np.abs(np.asarray(x) - np.asarray(y))))
+               for x, y in zip(jax.tree_util.tree_leaves(a),
+                               jax.tree_util.tree_leaves(b)))
+
+
+def bench_chaos() -> dict:
+    """kill -9 of a mid-pipeline stage's agent node during a 4-stage x
+    8-microbatch 1F1B batch with wave-boundary checkpoints (wave = 4).
+    Gates: repaired numerics equal the no-fault oracle, redo <= one
+    wave, repaired wall <= 2x the no-fault wall."""
+    import threading
+
+    from ray_tpu import state
+    from ray_tpu.train.pipeline import Pipeline, \
+        single_program_reference
+
+    WAVE = 4
+    cluster, handles = _start_cluster(STAGES)
+    lag = _LoopLag().snap()
+    try:
+        stages, loss_fn, mbs, tgts = _mk_ckpt_jax_stages(
+            STAGES, fwd_sleep_s=0.3)
+        ref_loss, ref_grads = single_program_reference(
+            stages, loss_fn, mbs, tgts)
+        pipe = Pipeline(stages, loss_fn=loss_fn, schedule="1f1b",
+                        max_inflight_microbatches=WAVE)
+        pipe._refresh_stage_nodes()
+        # no-fault reference run (also warms workers/imports)
+        pipe.run_batch(mbs, tgts, by_ref_min_bytes=0)  # warm
+        pipe.reset()
+        t0 = time.perf_counter()
+        nofault = pipe.run_batch(mbs, tgts, by_ref_min_bytes=0)
+        wall_nofault = time.perf_counter() - t0
+        nofault_grads = pipe.grads()
+        err_nofault = max(
+            _tree_max_err(nofault_grads[k], ref_grads[k])
+            for k in range(STAGES))
+        # fault run: SIGKILL the agent hosting a MID-pipeline stage
+        victim_stage = 2
+        victim = pipe.stage_nodes[victim_stage]
+        handle = next(h for h in handles if h.node_idx == victim)
+        pipe.reset()
+        out = {}
+
+        def run():
+            t1 = time.perf_counter()
+            try:
+                out["res"] = pipe.run_batch(mbs, tgts,
+                                            by_ref_min_bytes=0)
+            except Exception as e:  # noqa: BLE001 — report, not crash
+                out["err"] = repr(e)
+            out["wall"] = time.perf_counter() - t1
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        kill_after = 0.4 * wall_nofault
+        time.sleep(kill_after)  # mid-batch
+        handle.terminate()  # kill -9 of the whole agent process
+        th.join(timeout=600)
+        repaired = not th.is_alive() and "res" in out
+        wall_fault = out.get("wall", float("inf"))
+        grads = pipe.grads() if repaired else None
+        err_fault = max(
+            _tree_max_err(grads[k], ref_grads[k])
+            for k in range(STAGES)) if repaired else float("inf")
+        loss_err = abs(out["res"]["loss"] - ref_loss) if repaired \
+            else float("inf")
+        st = pipe.stats()
+        evs = state.list_cluster_events(
+            filters=[("type", "=", "pipeline_stage_repaired")])
+        lag_delta = lag.delta()
+        pipe.shutdown()
+    finally:
+        for h in handles:
+            try:
+                h.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+        cluster.shutdown()
+    return {
+        "stages": STAGES, "microbatches": MICRO, "wave": WAVE,
+        "param_dim": CKPT_D, "fwd_sleep_s": 0.3,
+        "victim_stage": victim_stage, "victim_node": victim,
+        "kill_after_s": round(kill_after, 3),
+        "completed": repaired,
+        "error": out.get("err", ""),
+        "wall_nofault_s": round(wall_nofault, 3),
+        "wall_fault_s": round(wall_fault, 3),
+        "wall_ratio": round(wall_fault / wall_nofault, 3),
+        "grad_max_err_nofault": err_nofault,
+        "grad_max_err_repaired": err_fault,
+        "loss_err_repaired": loss_err,
+        "pipeline_repairs": st["pipeline_repairs"],
+        "repair_redo_microbatches": st["repair_redo_microbatches"],
+        "repair_events": len(evs),
+        "gate_numerics_equal_oracle": bool(
+            repaired and loss_err < 1e-6 and err_fault < 1e-5),
+        "gate_redo_le_one_wave": bool(
+            repaired and 0 < st["repair_redo_microbatches"] <= WAVE),
+        "gate_wall_le_2x_nofault": bool(
+            repaired and wall_fault <= 2.0 * wall_nofault),
+        "loop_lag": lag_delta,
+    }
+
+
+def bench_drain() -> dict:
+    """Graceful drain of a node hosting a live stage mid-batch: the
+    stage migrates at a wave boundary BEFORE the shutdown. Gates: zero
+    failed tasks, drain_migrated_leases >= 1, the drained node's
+    object copies remain fetchable from survivors."""
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import state
+    from ray_tpu.core.api import NodeAffinitySchedulingStrategy
+    from ray_tpu.train.pipeline import Pipeline, \
+        single_program_reference
+
+    WAVE = 2
+    # one spare agent beyond the stages: the migration target needs a
+    # free CPU while the old stage actor still holds the victim's
+    cluster, handles = _start_cluster(STAGES)
+    lag = _LoopLag().snap()
+    try:
+        stages, loss_fn, mbs, tgts = _mk_ckpt_jax_stages(
+            STAGES, fwd_sleep_s=0.2)
+        ref_loss, ref_grads = single_program_reference(
+            stages, loss_fn, mbs, tgts)
+        pipe = Pipeline(stages, loss_fn=loss_fn, schedule="1f1b",
+                        max_inflight_microbatches=WAVE)
+        pipe._refresh_stage_nodes()
+        victim_stage = 1
+        victim = pipe.stage_nodes[victim_stage]
+
+        # a sole-copy object pinned on the victim: the drain must
+        # leave it fetchable from survivors
+        @ray_tpu.remote
+        def make(n):
+            return np.full(n, 3.0, np.float32)
+
+        # num_cpus=0: the stage actor holds the victim's only CPU — a
+        # 1-CPU marker task could never lease there
+        marker = make.options(
+            num_cpus=0,
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                victim)).remote(200_000)
+        ray_tpu.get(marker, timeout=60)
+        pipe.run_batch(mbs[:2], tgts[:2], by_ref_min_bytes=0)  # warm
+        pipe.reset()
+        failed_before = len([r for r in state.list_tasks(limit=5000)
+                             if r["state"] == "FAILED"])
+        out = {}
+
+        def run():
+            try:
+                out["res"] = pipe.run_batch(mbs, tgts,
+                                            by_ref_min_bytes=0)
+            except Exception as e:  # noqa: BLE001 — report, not crash
+                out["err"] = repr(e)
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        time.sleep(1.5)  # mid-batch
+        drained = ray_tpu.drain_node(victim)
+        th.join(timeout=600)
+        completed = not th.is_alive() and "res" in out
+        grads = pipe.grads() if completed else None
+        err = max(_tree_max_err(grads[k], ref_grads[k])
+                  for k in range(STAGES)) if completed else float("inf")
+        st = pipe.stats()
+        # wait out the drain completion
+        deadline = time.monotonic() + 90
+        gone = False
+        while time.monotonic() < deadline:
+            rows = [r for r in state.list_nodes()
+                    if r["node_idx"] == victim]
+            if not rows:
+                gone = True
+                break
+            time.sleep(0.5)
+        io = state.io_loop_stats()[0]
+        failed_after = len([r for r in state.list_tasks(limit=5000)
+                            if r["state"] == "FAILED"])
+        locs = ray_tpu.object_locations(marker)
+        fetched = ray_tpu.get(marker, timeout=60)
+        marker_ok = bool(float(fetched[0]) == 3.0
+                         and victim not in locs["holders"])
+        types = [e["type"] for e in state.list_cluster_events()]
+        lag_delta = lag.delta()
+        pipe.shutdown()
+    finally:
+        for h in handles:
+            try:
+                h.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+        cluster.shutdown()
+    return {
+        "stages": STAGES, "microbatches": MICRO, "wave": WAVE,
+        "victim_stage": victim_stage, "victim_node": victim,
+        "drain_started": bool(drained), "completed": completed,
+        "error": out.get("err", ""),
+        "node_gone": gone,
+        "grad_max_err": err,
+        "stage_migrations": st["stage_migrations"],
+        "pipeline_repairs": st["pipeline_repairs"],
+        "drain_migrated_leases": io["drain_migrated_leases"],
+        "drains_completed": io["drains_completed"],
+        "drains_forced": io["drains_forced"],
+        "failed_tasks_during": failed_after - failed_before,
+        "node_drained_event": "node_drained" in types,
+        "marker_fetchable_from_survivors": marker_ok,
+        "gate_zero_failed_tasks": failed_after - failed_before == 0,
+        "gate_migrated_leases_ge_1": io["drain_migrated_leases"] >= 1,
+        "gate_copies_survive": marker_ok,
+        "gate_numerics_equal_oracle": bool(completed and err < 1e-5),
+        "loop_lag": lag_delta,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--pairs", type=int, default=3)
     ap.add_argument("--phases", default="schedule,hints",
-                    help="comma list: schedule,hints")
+                    help="comma list: schedule,hints,chaos,drain")
     ap.add_argument("--out", default="BENCH_pipeline_r15.json")
+    ap.add_argument("--repair-out", default="BENCH_repair_r16.json",
+                    help="artifact for the chaos/drain (r16) phases")
     args = ap.parse_args()
     phases = {p.strip() for p in args.phases.split(",") if p.strip()}
 
@@ -406,6 +692,31 @@ def main():
         with open(args.out, "w") as f:
             json.dump(result, f, indent=1)
 
+    # r16 repair phases merge into their own artifact (same process-
+    # merge convention as the r15 phases)
+    repair = {
+        "benchmark": "pipeline_repair_r16",
+        "hardware": f"single host, {os.cpu_count()} cpu, "
+                    "real agent processes, per-process egress buckets",
+        "methodology": "chaos = kill -9 of a mid-pipeline stage's "
+                       "agent node mid-1F1B-batch vs the no-fault run "
+                       "and the driver-side oracle; drain = graceful "
+                       "drain_node of a live stage's node",
+    }
+    if os.path.exists(args.repair_out):
+        try:
+            with open(args.repair_out) as f:
+                prior = json.load(f)
+            for k in ("chaos", "drain"):
+                if k in prior:
+                    repair[k] = prior[k]
+        except (OSError, ValueError):
+            pass
+
+    def flush_repair():
+        with open(args.repair_out, "w") as f:
+            json.dump(repair, f, indent=1)
+
     if "schedule" in phases:
         print(f"# schedule: {STAGES}-stage x {MICRO}-microbatch 1F1B "
               f"vs sequential, {args.pairs} pairs",
@@ -419,7 +730,22 @@ def main():
         result["hints"] = bench_hints(args.pairs)
         print(json.dumps(result["hints"]), file=sys.stderr)
         flush()
-    print(json.dumps(result))
+    if "chaos" in phases:
+        print(f"# chaos: kill -9 mid-stage node, {STAGES}-stage x "
+              f"{MICRO}-microbatch 1F1B", file=sys.stderr, flush=True)
+        repair["chaos"] = bench_chaos()
+        print(json.dumps(repair["chaos"]), file=sys.stderr)
+        flush_repair()
+    if "drain" in phases:
+        print("# drain: graceful drain of a live stage's node",
+              file=sys.stderr, flush=True)
+        repair["drain"] = bench_drain()
+        print(json.dumps(repair["drain"]), file=sys.stderr)
+        flush_repair()
+    if "chaos" in phases or "drain" in phases:
+        print(json.dumps(repair))
+    if "schedule" in phases or "hints" in phases:
+        print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -24,6 +24,7 @@ from ray_tpu.core.api import (
     available_resources,
     cancel,
     cluster_resources,
+    drain_node,
     get,
     get_actor,
     get_tpu_ids,
@@ -61,7 +62,7 @@ __all__ = [
     "remove_placement_group", "placement_group_table",
     "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
     "nodes", "cluster_resources", "available_resources", "timeline",
-    "object_locations", "warm_object",
+    "object_locations", "warm_object", "drain_node",
     "RayTaskError", "ActorDiedError", "ActorUnavailableError",
     "GetTimeoutError", "ObjectLostError", "TaskCancelledError",
     "WorkerCrashedError",

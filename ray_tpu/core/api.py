@@ -630,6 +630,28 @@ def warm_object(ref: ObjectRef, node_idx: int = -1, *,
     return 0
 
 
+def drain_node(node_idx: int, *, timeout: float = 30.0) -> bool:
+    """Begin a GRACEFUL drain of a node (r16; reference: the
+    NodeManager ``DrainNode`` RPC behind the autoscaler's planned
+    scale-down). The head immediately stops granting leases /
+    placements / prefetches onto the node, replicates its sole-copy
+    objects to survivors, and publishes ``node_draining`` so running
+    workloads (e.g. ``train.Pipeline`` stage migration) move their work
+    off; once every in-flight lease completes — or ``drain_deadline_s``
+    passes — the node is removed with the deliberate ``SHUTDOWN_NODE``
+    (``node_drained`` / ``drain_forced`` cluster events). Returns True
+    when the drain was started (or already in progress); False for an
+    unknown/dead node or the head's bootstrap node (node 0 — draining
+    it would escalate to removing the head host's own arena).
+    Non-blocking: poll ``state.list_nodes`` for the ``draining`` flag /
+    node removal."""
+    from . import protocol as P
+
+    (ok,) = get_context().head.call(P.DRAIN_NODE, int(node_idx),
+                                    timeout=timeout)
+    return bool(ok)
+
+
 def cluster_resources() -> dict:
     total: dict = {}
     for n in nodes():

@@ -198,6 +198,41 @@ class Config:
     # many microbatches.
     pipeline_max_inflight_microbatches: int = 0
 
+    # --- elastic pipeline repair (r16) ---
+    # Object-plane stage checkpoints: every this-many completed WAVES
+    # (see ``pipeline_max_inflight_microbatches`` — with bound 0 the
+    # whole batch is one wave) each ``_StageWorker`` snapshots its
+    # params + accumulated grads + microbatch count as a by-ref tree
+    # (plasma-resident on the stage's node via the r13 typed zero-copy
+    # reducer for ``jax.Array`` leaves); the driver holds one ref per
+    # stage tagged by wave, replicates sole-copy snapshots off the
+    # producing node (so a node kill cannot take the only copy with
+    # it), and frees the previous wave's refs eagerly — O(stages)
+    # checkpoint footprint, the same discipline as activations. On a
+    # stage's node death the gang restores to the latest checkpointed
+    # wave and replays ONLY the waves since it (redo bounded by this
+    # knob x the wave size). <= 0 disables checkpointing AND the repair
+    # path entirely (a stage death fails the batch, the pre-r16
+    # behavior).
+    pipeline_checkpoint_every_waves: int = 1
+    # How many stage-death repairs one ``train.Pipeline`` absorbs
+    # before giving up and re-raising the failure to the caller — a
+    # node that dies repeatedly (or a cluster with no capacity left to
+    # re-place the stage) must not retry forever. Counted per repair
+    # event (one event may re-place several co-located stages).
+    pipeline_max_repairs: int = 3
+    # Graceful node drain (``ray_tpu.drain_node`` / ``DRAIN_NODE``):
+    # how long the head waits for a draining node's in-flight leases to
+    # complete (and its sole-copy objects to replicate off) before
+    # force-escalating to the deliberate r12 ``SHUTDOWN_NODE`` anyway
+    # (``drain_forced`` cluster event; surviving work then rides the
+    # normal lineage/retry machinery). While draining, the node takes
+    # no new leases, placements, or prefetch/warm pulls; holders keep
+    # serving so copies replicate off via the existing pull machinery.
+    # ``doctor_warnings()`` flags a node stuck draining past this
+    # deadline (the escalation itself wedged).
+    drain_deadline_s: float = 30.0
+
     # --- serve at scale (r14) ---
     # How long a ``slow_node`` detector flag stays routable-around: the
     # head marks the node slow in its `nodes` state rows for this long

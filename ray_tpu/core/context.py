@@ -285,8 +285,16 @@ class CoreContext:
         self.prefetch_hints_sent = 0
         self.prefetch_hints_suppressed = 0
         self.prefetch_hints_coalesced = 0
+        # r16: hint-buffer values are [arg_ids, inline_ids] — the
+        # second list tags which ids are INLINE-PROMOTED objects
+        # (_promote_if_needed materialized a tiny owner value into the
+        # store only so a borrower could fetch it, e.g. a pipeline
+        # backward cotangent); the head counts their pulls apart so
+        # the prefetch waste-ratio check measures only real
+        # speculation. Bounded id memory below.
         self._hint_buf: "OrderedDict[str, list]" = OrderedDict()
         self._hint_lock = threading.Lock()
+        self._inline_promoted: "OrderedDict[bytes, None]" = OrderedDict()
         self._sub_lock = threading.RLock()
         self._submit_event = threading.Event()
         self._submitter = threading.Thread(target=self._submitter_loop,
@@ -442,6 +450,21 @@ class CoreContext:
                 self.head.call(P.SUBSCRIBE, channel, timeout=30)
             else:
                 self.head.send(P.SUBSCRIBE, channel)
+
+    def unsubscribe(self, channel: str, handler) -> None:
+        """Remove one handler registered via ``subscribe``. The head
+        subscription itself stays (cheap; channels are few and other
+        handlers may share it) — this exists so long-lived drivers
+        that register per-object handlers (e.g. a Pipeline's drain
+        watchers) can drop them at shutdown instead of growing the
+        handler list forever."""
+        with self._pub_lock:
+            lst = self._pub_handlers.get(channel)
+            if lst is not None:
+                try:
+                    lst.remove(handler)
+                except ValueError:
+                    pass
 
     def publish(self, channel: str, data):
         from .serialization import dumps
@@ -896,6 +919,14 @@ class CoreContext:
         e.in_plasma = True
         e.node_idx = self.node_idx
         e.plasma_size = sv.total_bytes
+        # remember the id so dispatch-time prefetch hints can tag it:
+        # pulls of inline-promoted tiny values are not the speculation
+        # the head's waste-ratio accounting should judge (r16)
+        with self._hint_lock:
+            ip = self._inline_promoted
+            ip[ref.id.binary()] = None
+            while len(ip) > 4096:
+                ip.popitem(last=False)
 
     def _enqueue_spec(self, spec: TaskSpec, arg_ids, holder) -> List[ObjectRef]:
         refs = [ObjectRef(oid, self.worker_id, _register=False)
@@ -1172,19 +1203,30 @@ class CoreContext:
             # already has a pending flush merges into it — that is one
             # whole frame saved, counted in prefetch_hints_coalesced.
             with self._hint_lock:
+                inline = [ab for ab in ids
+                          if ab in self._inline_promoted]
                 buf = self._hint_buf.get(lease_key)
                 if buf is None:
-                    self._hint_buf[lease_key] = list(ids)
+                    self._hint_buf[lease_key] = [list(ids), inline]
                 else:
                     self.prefetch_hints_coalesced += 1
-                    seen = set(buf)
-                    buf.extend(ab for ab in ids if ab not in seen)
+                    seen = set(buf[0])
+                    buf[0].extend(ab for ab in ids if ab not in seen)
+                    seen = set(buf[1])
+                    buf[1].extend(ab for ab in inline
+                                  if ab not in seen)
             self._submit_event.set()
             return
         with self._hint_lock:
             self.prefetch_hints_sent += 1
+            inline = [ab for ab in ids if ab in self._inline_promoted]
         try:
-            self.head.send(P.PREFETCH_HINT, lease_key, ids)
+            # the inline-tag field ships only when non-empty: the
+            # common no-inline frame stays byte-identical to r15's
+            if inline:
+                self.head.send(P.PREFETCH_HINT, lease_key, ids, inline)
+            else:
+                self.head.send(P.PREFETCH_HINT, lease_key, ids)
         except P.ConnectionLost:
             pass  # speculation only: the demand path still works
 
@@ -1198,14 +1240,17 @@ class CoreContext:
         with self._hint_lock:
             if not self._hint_buf:
                 return
-            entries = list(self._hint_buf.items())
+            # entries keep the 2-tuple shape unless a destination has
+            # inline-tagged ids (r16) — no-inline frames stay
+            # byte-identical to r15's, and r15 heads decode 2-tuples
+            entries = [(k, v[0], v[1]) if v[1] else (k, v[0])
+                       for k, v in self._hint_buf.items()]
             self._hint_buf.clear()
         if not self.head.is_attached():
             return  # head outage: drop — demand path still works
         try:
             if len(entries) == 1:
-                self.head.send(P.PREFETCH_HINT, entries[0][0],
-                               entries[0][1])
+                self.head.send(P.PREFETCH_HINT, *entries[0])
             else:
                 self.head.send(P.PREFETCH_HINT_BATCH, entries)
         except P.ConnectionLost:
@@ -1767,6 +1812,19 @@ class CoreContext:
             self._finish_cancelled(spec)
         else:
             self._complete_task_error(spec, err)
+
+    def actor_state(self, actor_id: ActorID) -> str:
+        """This process's current view of an actor's lifecycle state:
+        ``"ALIVE" | "RESTARTING" | "DEAD" | "UNKNOWN"`` (UNKNOWN =
+        never watched, or no notification yet). Driven by the head's
+        ``actor:<id>`` pubsub — DEAD lands the moment the head marks
+        the death, i.e. the same signal that fails pending calls with
+        ``ActorDiedError``. The supported death-detection query for
+        callers like the pipeline repair planner (do not reach into
+        ``_actors`` directly)."""
+        with self._sub_lock:
+            st = self._actors.get(actor_id)
+        return st.state if st is not None else "UNKNOWN"
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self.head.call(P.KILL_ACTOR, actor_id.binary(), no_restart,

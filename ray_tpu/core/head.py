@@ -106,6 +106,15 @@ class NodeState:
     health_failures: int = 0
     last_ping: float = 0.0
     ping_inflight: bool = False
+    # graceful drain (r16): set by drain_node() — excluded from lease
+    # grants / placements / prefetch targets while its in-flight leases
+    # complete and sole-copy objects replicate off; past
+    # ``drain_deadline_s`` the removal force-escalates (drain_forced)
+    draining: bool = False
+    drain_started: float = 0.0     # monotonic, when the drain began
+    drain_replicated: bool = False   # last replication pass was clean
+    drain_replicating: bool = False  # a replication pass is in flight
+    drain_last_pass: float = 0.0     # when the last pass ended
     # RTT-midpoint estimate of (agent monotonic clock - head monotonic
     # clock), sampled at registration and refreshed by every health
     # probe; applied when folding this node's task-event stamps into the
@@ -162,6 +171,12 @@ class _PrefetchState:
     charged: list = field(default_factory=list)
     state: str = "inflight"  # inflight | done | aborted
     consumed: bool = False
+    # r16: the driver tagged this arg as an INLINE-PROMOTED object (a
+    # tiny value materialized into the store only so a borrower could
+    # fetch it, e.g. a pipeline backward cotangent) — its pull is
+    # counted in the *_inline counters, outside the issued/wasted
+    # ratio the doctor waste check judges
+    inline: bool = False
 
 
 # inflight/aborted prefetch entries whose agent never answered (died,
@@ -338,6 +353,23 @@ class Head:
         self.prefetch_completed = 0  # pulls that landed their copy
         self.prefetch_wasted = 0     # aborted: task cancelled/retried
         self.prefetch_bytes_issued = 0
+        # r16: pulls of INLINE-PROMOTED objects (tiny values an owner
+        # materialized into the store only so borrowers could fetch
+        # them — e.g. pipeline backward cotangents) are counted apart:
+        # they are real pulls but not the speculation the waste-ratio
+        # doctor check judges, and on this 2-vCPU class of host they
+        # were padding prefetch_issued by one per microbatch
+        self.prefetch_issued_inline = 0
+        self.prefetch_completed_inline = 0
+        self.prefetch_wasted_inline = 0
+        # graceful node drain (r16): counters behind the io_loop state
+        # row — migrated = leases released off a draining node while it
+        # was still alive (work moved, nothing died)
+        self.drains_started = 0
+        self.drains_completed = 0
+        self.drains_forced = 0
+        self.drain_migrated_leases = 0
+        self.drain_objects_replicated = 0
         # Worker spawner queue (drained by the spawner thread, started in
         # start()): created here so _try_grant can enqueue spawns even on
         # heads that are never start()ed (unit tests drive handlers
@@ -872,6 +904,254 @@ class Head:
                              daemon=True, name="clock-probe").start()
         self._try_fulfill_pending()
 
+    # --------------------------------------------------- graceful drain
+
+    def drain_node(self, idx: int) -> bool:
+        """Begin a GRACEFUL drain (r16; reference: the NodeManager
+        ``DrainNode`` RPC the autoscaler uses for planned scale-down —
+        node_manager.cc HandleDrainNode — vs the kill path chaos
+        exercises). The node is immediately excluded from lease grants,
+        placements and prefetch/warm targets (``scheduler.drain_node``
+        pulls it from the schedulable set); its sole-copy objects
+        replicate off via the existing pull machinery; and once every
+        in-flight lease has completed — or ``drain_deadline_s`` passes,
+        whichever first — the deliberate r12 ``SHUTDOWN_NODE`` removal
+        fires. A ``node_draining`` event + pubsub frame lets workloads
+        (the pipeline's stage migration) move their work off BEFORE the
+        shutdown instead of eating a crash. Idempotent; False when the
+        node is unknown/dead — or the BOOTSTRAP node (idx 0): that is
+        the head host's own node, whose arena the driver puts into and
+        whose removal the drain would escalate to, bricking the
+        cluster from one CLI command (the reference likewise never
+        drains the head node)."""
+        if idx == 0:
+            return False
+        with self._lock:
+            node = self.nodes.get(idx)
+            if node is None or not node.alive:
+                return False
+            if node.draining:
+                return True
+            node.draining = True
+            node.drain_started = time.monotonic()
+            node.drain_replicating = True  # first pass spawns below
+            self.scheduler.drain_node(idx)
+            self.drains_started += 1
+            live_leases = sum(1 for l in self.leases.values()
+                              if l[0] == idx)
+        # speculative pulls aimed at a departing host are wasted work
+        # (and would re-create copies the drain is moving off)
+        self._purge_node_prefetches(idx)
+        deadline_s = get_config().drain_deadline_s
+        self.emit_event(
+            "WARNING", "head", "node_draining",
+            f"node {idx} draining: {live_leases} in-flight leases, "
+            f"deadline {deadline_s:g}s",
+            node_idx=idx,
+            extra={"live_leases": live_leases,
+                   "drain_deadline_s": deadline_s})
+        self._publish("node_draining", dumps(idx))
+        threading.Thread(target=self._replicate_off_node, args=(idx,),
+                         daemon=True, name=f"drain-replicate-{idx}")\
+            .start()
+        return True
+
+    class _ReplySink:
+        """Throwaway conn stand-in for internal reuse of reply-shaped
+        helpers (the drain replication pass drives _do_object_transfer
+        with no requester to answer)."""
+
+        def __init__(self):
+            self.ok = False
+            self.err = None
+
+        def reply(self, rid, *fields, **kw):
+            self.ok = True
+
+        def reply_error(self, rid, err):
+            self.err = err
+
+    def _replicate_off_node(self, idx: int):
+        """Drain replication pass: every object whose ONLY arena copy
+        lives on the draining node is copied to a surviving node
+        through the normal transfer machinery (store-to-store for
+        remote targets, arena memcpy for head-local ones), so the
+        eventual SHUTDOWN_NODE loses no data. Spilled objects already
+        survive on disk. Sets ``drain_replicated`` when done — the
+        drain completion check waits for it (up to the deadline)."""
+        moved = failed = 0
+        aborted = False
+        assigned_bytes: Dict[int, int] = {}  # spread across survivors
+        # ONE survivor snapshot per pass — re-scanning the node table
+        # under the head lock per object would serialize a large drain
+        # against the grant path O(objects) times. The per-object
+        # failover below tolerates a stale entry (a dying target just
+        # fails that transfer; the _check_drains retry re-snapshots).
+        with self._lock:
+            all_targets = [n for n in self.nodes.values()
+                           if n.alive and not n.draining
+                           and n.idx != idx
+                           and (n.store is not None
+                                or n.agent_conn is not None)]
+        for oid, loc in self.objects.items_snapshot():
+            with self.objects.lock_for(oid):
+                sole = self._is_sole_copy(idx, loc)
+            if not sole:
+                continue
+            if not all_targets:
+                aborted = True
+                break  # nowhere to put copies: deadline escalation
+            # least-loaded-first over the bytes THIS pass already
+            # assigned (tie -> lowest idx), with the rest as failover —
+            # funneling everything at one survivor would fill its
+            # arena and fail the replication the drain exists for
+            targets = sorted(
+                all_targets,
+                key=lambda n: (assigned_bytes.get(n.idx, 0), n.idx))
+            ok = False
+            for dst in targets:
+                sink = self._ReplySink()
+                try:
+                    self._do_object_transfer(sink, 0, oid, loc, dst)
+                except Exception:  # noqa: BLE001 — try the next target
+                    sink.err = sink.err or True
+                if sink.ok:
+                    ok = True
+                    assigned_bytes[dst.idx] = \
+                        assigned_bytes.get(dst.idx, 0) + loc.size
+                    break
+            if ok:
+                moved += 1
+                self.drain_objects_replicated += 1
+            else:
+                failed += 1
+        # the clean-finish path requires EVERY sole copy safely moved:
+        # an aborted or partly-failed pass leaves the flag unset, so
+        # the drain waits out the deadline and escalates with the
+        # honest drain_forced WARNING instead of reporting "copies
+        # replicated" over silent data loss
+        with self._lock:
+            node = self.nodes.get(idx)
+            if node is not None:
+                node.drain_replicating = False
+                node.drain_last_pass = time.monotonic()
+                if not aborted and failed == 0:
+                    node.drain_replicated = True
+        if moved or failed:
+            self.emit_event(
+                "INFO", "head", "node_draining",
+                f"node {idx} drain replication: {moved} sole-copy "
+                f"objects moved off" + (f", {failed} failed" if failed
+                                        else ""),
+                node_idx=idx,
+                extra={"replicated": moved, "failed": failed})
+
+    @staticmethod
+    def _is_sole_copy(idx: int, loc: _ObjLoc) -> bool:
+        """The ONE sole-copy predicate drain replication and its
+        completion re-scan must agree on (caller holds the object's
+        shard lock) — two drifting copies would either finish a drain
+        over unreplicated objects or loop passes forever."""
+        return (idx in loc.holders and len(loc.holders) == 1
+                and not loc.spilled_path and loc.size > 0)
+
+    def _sole_copy_count(self, idx: int) -> int:
+        """Objects whose ONLY arena copy lives on node ``idx`` (the
+        drain completion check re-verifies this right before removal —
+        a lease still running during the replication pass may have
+        put() fresh sole copies after the pass scanned)."""
+        count = 0
+        for oid, loc in self.objects.items_snapshot():
+            with self.objects.lock_for(oid):
+                if self._is_sole_copy(idx, loc):
+                    count += 1
+        return count
+
+    def _check_drains(self):
+        """Housekeeping: complete or escalate in-progress drains. A
+        drain completes — ``node_drained`` + the deliberate removal
+        (SHUTDOWN_NODE to the agent) — once the node holds no live
+        leases, the replication pass finished clean, AND a final
+        sole-copy re-scan comes back empty (objects created on the
+        node AFTER the pass re-run it rather than dying with the
+        removal); past ``drain_deadline_s`` it force-escalates
+        (``drain_forced``) instead of wedging, and surviving work
+        rides the normal lineage/retry machinery."""
+        deadline_s = get_config().drain_deadline_s
+        now = time.monotonic()
+        candidates: List[int] = []
+        repass: List[int] = []
+        force: List[Tuple[int, int, bool]] = []
+        with self._lock:
+            for node in self.nodes.values():
+                if not node.draining or not node.alive:
+                    continue
+                left = sum(1 for l in self.leases.values()
+                           if l[0] == node.idx)
+                if now - node.drain_started > deadline_s:
+                    force.append((node.idx, left,
+                                  node.drain_replicated))
+                elif left == 0 and node.drain_replicated \
+                        and not node.drain_replicating:
+                    candidates.append(node.idx)
+                elif not node.drain_replicated \
+                        and not node.drain_replicating \
+                        and now - node.drain_last_pass > 1.0:
+                    # the last pass failed (transient transfer error,
+                    # or momentarily no target) — keep retrying inside
+                    # the deadline rather than letting one hiccup turn
+                    # into a forced escalation
+                    node.drain_replicating = True
+                    repass.append(node.idx)
+        for idx in repass:
+            threading.Thread(target=self._replicate_off_node,
+                             args=(idx,), daemon=True,
+                             name=f"drain-replicate-{idx}").start()
+        finish: List[int] = []
+        for idx in candidates:
+            if self._sole_copy_count(idx) == 0:
+                finish.append(idx)
+                continue
+            # fresh sole copies landed after the replication pass (a
+            # then-live lease put() them): run another pass before
+            # declaring the drain clean
+            with self._lock:
+                node = self.nodes.get(idx)
+                if node is None or node.drain_replicating:
+                    continue
+                node.drain_replicated = False
+                node.drain_replicating = True
+            threading.Thread(target=self._replicate_off_node,
+                             args=(idx,), daemon=True,
+                             name=f"drain-replicate-{idx}").start()
+        for idx in finish:
+            self.drains_completed += 1
+            self.emit_event(
+                "INFO", "head", "node_drained",
+                f"node {idx} drained: all leases migrated, copies "
+                "replicated; shutting it down",
+                node_idx=idx,
+                extra={"forced": False,
+                       "migrated_leases": self.drain_migrated_leases})
+            self._publish("node_drained", dumps(idx))
+            self.remove_node(idx)
+        for idx, left, replicated in force:
+            self.drains_forced += 1
+            self.emit_event(
+                "WARNING", "head", "drain_forced",
+                f"node {idx} drain deadline ({deadline_s:g}s) passed "
+                f"with {left} leases still live"
+                + ("" if replicated
+                   else " and sole-copy replication incomplete")
+                + " — force-removing (surviving work retries via "
+                "lineage)",
+                node_idx=idx,
+                extra={"leases_killed": left,
+                       "replication_done": replicated,
+                       "drain_deadline_s": deadline_s})
+            self._publish("node_drained", dumps(idx))
+            self.remove_node(idx)
+
     def remove_node(self, idx: int, kill_workers: bool = True):
         """Node failure (chaos testing / scale-down / agent loss)."""
         with self._lock:
@@ -905,17 +1185,35 @@ class Head:
         # and release their source charges (no waste counting — host
         # loss, not task churn)
         self._purge_node_prefetches(idx)
+        # a drained node's removal is the PLANNED end of a graceful
+        # drain, not a failure — keep severity-based alerting honest
         self.emit_event(
-            "ERROR", "head", "node_dead",
+            "INFO" if node.draining else "ERROR", "head", "node_dead",
             f"node {idx} removed"
-            + (" (agent lost/evicted)" if node.is_remote else ""),
+            + (" after graceful drain" if node.draining else "")
+            + (" (agent lost/evicted)"
+               if node.is_remote and not node.draining else ""),
             node_idx=idx,
             extra={"is_remote": node.is_remote,
+                   "drained": node.draining,
                    "workers_killed": len(node.workers)
                    if kill_workers else 0})
         if kill_workers:
-            for w in list(node.workers.values()):
+            doomed = list(node.workers.values())
+            for w in doomed:
                 self._kill_worker_process(w)
+            for w in doomed:
+                if w.actor_id is not None:
+                    # _kill_worker_process pre-marks the worker "dead",
+                    # which SUPPRESSES the conn-close death path — so a
+                    # node removal used to leave its actors ALIVE with a
+                    # dead address and pending callers hung to their
+                    # timeout. Route the death explicitly: restartable
+                    # actors reschedule elsewhere, the rest go DEAD and
+                    # every pending caller gets a prompt ActorDiedError
+                    # (the surface the r16 pipeline repair planner
+                    # relies on).
+                    self._on_actor_worker_death(w.actor_id)
         # objects whose ONLY copy lived on this node are lost: answer any
         # blocked locates with the LOST sentinel (-2) and remember the ids
         # so later locates fail fast — owners react by re-executing the
@@ -1686,6 +1984,10 @@ class Head:
             node = self.nodes.get(node_idx)
             if node is None:
                 return
+            if node.draining and node.alive:
+                # the lease ended while its node drains: work moved off
+                # cleanly instead of dying with the shutdown
+                self.drain_migrated_leases += 1
             if pg_binding:
                 self._pg_release(pg_binding[0], pg_binding[1], request)
             else:
@@ -1723,6 +2025,11 @@ class Head:
                 if w.lease_id and w.lease_id in self.leases:
                     node_idx, request, _, pg_binding, tpu_ids = \
                         self.leases.pop(w.lease_id)
+                    if node.draining and node.alive and not unexpected:
+                        # deliberate kill during a drain (e.g. the
+                        # pipeline retiring its migrated stage actor):
+                        # the lease moved off, nothing failed
+                        self.drain_migrated_leases += 1
                     if pg_binding:
                         self._pg_release(pg_binding[0], pg_binding[1], request)
                     else:
@@ -1860,6 +2167,7 @@ class Head:
             self._mark_actor_dead(info, cause)
 
     def _on_actor_worker_death(self, actor_id: ActorID):
+        waiters: List[Tuple[P.Connection, int]] = []
         with self._lock:
             info = self.actors.get(actor_id)
             if info is None or info.state == "DEAD":
@@ -1874,6 +2182,18 @@ class Head:
                 info.state = "DEAD"
                 info.death_cause = "worker died"
                 self._release_actor_name(info)
+                # GET_ACTOR waiters queued while the actor was
+                # PENDING/RESTARTING must hear the death — the pubsub
+                # channel alone leaves their blocking calls (and the
+                # head-side waiter entries) stranded forever
+                waiters = list(info.pending_get_replies)
+                info.pending_get_replies.clear()
+        for wconn, wrid in waiters:
+            try:
+                wconn.reply(wrid, "DEAD", info.death_cause,
+                            msg_type=P.GET_ACTOR_REPLY)
+            except P.ConnectionLost:
+                pass  # that waiter died; the rest must still hear
         if info.state == "RESTARTING":
             self.emit_event(
                 "WARNING", "head", "actor_restarted",
@@ -2509,7 +2829,7 @@ class Head:
     # ---------------------------------- speculative arg prefetch (r13)
 
     def _maybe_prefetch_args(self, lease_id: str, node_idx: int,
-                             arg_ids) -> int:
+                             arg_ids, inline_ids=()) -> int:
         """Fire prefetch-flagged PULL_OBJECTs at ``node_idx``'s agent
         for every by-ref arg its directory entry is missing (the
         reference PullManager's prefetch role). Called off the head
@@ -2520,7 +2840,16 @@ class Head:
         in-flight pull via the agent puller's ``_pending`` leadership
         instead of starting cold. Remote nodes only: a head-local
         node's consumers share the head host's arenas, where the demand
-        path is an in-memory hop. Returns how many pulls were issued."""
+        path is an in-memory hop. Returns how many pulls were issued.
+
+        ``inline_ids`` (r16): arg ids the DRIVER tagged as
+        inline-promoted — tiny owner values materialized into the store
+        only so borrowers can fetch them (``_promote_if_needed``).
+        Their pulls still fire (the demand path would fetch them
+        anyway) but count in ``prefetch_issued_inline`` /
+        ``prefetch_wasted_inline``, so the issued/wasted ratio behind
+        ``doctor_warnings()``'s waste check measures only REAL
+        speculative pulls."""
         cfg = get_config()
         if not cfg.arg_prefetch_enabled or \
                 cfg.arg_prefetch_max_inflight <= 0 or not arg_ids:
@@ -2532,11 +2861,15 @@ class Head:
             # entries do too — teardown never names these keys)
             synthetic = lease_id == _WARM_LEASE or \
                 lease_id.startswith("actor:")
-            if node is None or not node.alive or node.agent_conn is None \
+            if node is None or not node.alive or node.draining \
+                    or node.agent_conn is None \
                     or (not synthetic and lease_id not in self.leases):
+                # draining nodes are never prefetch DESTINATIONS (the
+                # copies are moving off); they may still SERVE pulls
                 return 0
             conn = node.agent_conn
         issued = 0
+        inline_set = {bytes(a) for a in inline_ids}
         for ab in dict.fromkeys(bytes(a) for a in arg_ids):
             oid = ObjectID(ab)
             loc = self.objects.get(oid)
@@ -2565,11 +2898,12 @@ class Head:
                         node_idx, deque())
                     if len(q) < 256 and \
                             not any(e[1] == ab for e in q):
-                        q.append((lease_id, ab))
+                        q.append((lease_id, ab, ab in inline_set))
                     continue
                 p = _PrefetchState(oid_bin=ab, node_idx=node_idx,
                                    lease_id=lease_id, size=loc.size,
-                                   ts=time.monotonic())
+                                   ts=time.monotonic(),
+                                   inline=ab in inline_set)
                 self._prefetches[key] = p
                 self._prefetch_by_lease.setdefault(
                     lease_id, []).append(key)
@@ -2602,12 +2936,16 @@ class Head:
                 self._prefetch_finished(ab, node_idx, ok=False)
                 continue
             with self._prefetch_lock:
-                self.prefetch_issued += 1
-                self.prefetch_bytes_issued += loc.size
+                if p.inline:
+                    self.prefetch_issued_inline += 1
+                else:
+                    self.prefetch_issued += 1
+                    self.prefetch_bytes_issued += loc.size
             issued += 1
         return issued
 
-    def _h_prefetch_hint(self, conn, rid, lease_id, arg_bins):
+    def _h_prefetch_hint(self, conn, rid, lease_id, arg_bins,
+                         inline_bins=()):
         """Driver dispatch-time prefetch (PREFETCH_HINT): leases are
         long-lived and serve many tasks, so grant-time args cover only
         the first — the submitter names each pushed batch's by-ref args
@@ -2615,27 +2953,34 @@ class Head:
         of the form ``actor:<hex>`` name an ACTOR's pushed batch (the
         serve-handle hot loop); the head resolves the actor to its
         worker's node here — the driver only knows the actor's socket
-        address, not its node."""
+        address, not its node. r16: the optional third field names the
+        subset of ``arg_bins`` that are inline-promoted objects (their
+        pulls are counted apart from real speculation — absent from
+        pre-r16 drivers, which is equivalent to empty)."""
         if isinstance(lease_id, str) and lease_id.startswith("actor:"):
             node_idx = self._actor_node_idx(lease_id[len("actor:"):])
             if node_idx is not None:
-                self._maybe_prefetch_args(lease_id, node_idx, arg_bins)
+                self._maybe_prefetch_args(lease_id, node_idx, arg_bins,
+                                          inline_ids=inline_bins)
             return
         with self._lock:
             lease = self.leases.get(lease_id)
         if lease is None:
             return  # lease already returned: nothing to speculate for
-        self._maybe_prefetch_args(lease_id, lease[0], arg_bins)
+        self._maybe_prefetch_args(lease_id, lease[0], arg_bins,
+                                  inline_ids=inline_bins)
 
     def _h_prefetch_hint_batch(self, conn, rid, entries):
         """PREFETCH_HINT_BATCH (r15): one frame carrying every hint a
         driver buffered since its last submitter wakeup — a pipeline
         hot loop's per-microbatch activations arrive as one frame per
-        tick instead of one per pushed batch. Each (lease_key, ids)
-        entry takes the exact single-hint path (actor resolution,
-        caps, holder checks, dedupe)."""
-        for lease_key, arg_bins in entries:
-            self._h_prefetch_hint(conn, 0, lease_key, arg_bins)
+        tick instead of one per pushed batch. Each (lease_key, ids[,
+        inline_ids]) entry takes the exact single-hint path (actor
+        resolution, caps, holder checks, dedupe); 2-tuples from r15
+        drivers decode with no inline tags."""
+        for entry in entries:
+            self._h_prefetch_hint(conn, 0, entry[0], entry[1],
+                                  entry[2] if len(entry) > 2 else ())
 
     def _actor_node_idx(self, actor_hex: str) -> Optional[int]:
         """Node currently hosting an actor's worker (None when the
@@ -2672,10 +3017,11 @@ class Head:
             if node_idx >= 0:
                 node = self.nodes.get(node_idx)
                 targets = [node_idx] if node is not None and node.alive \
-                    else []
+                    and not node.draining else []
             else:
                 targets = [n.idx for n in self.nodes.values()
-                           if n.alive and n.agent_conn is not None]
+                           if n.alive and not n.draining
+                           and n.agent_conn is not None]
         issued = 0
         for idx in targets:
             issued += self._maybe_prefetch_args(_WARM_LEASE, idx, [ab])
@@ -2700,7 +3046,14 @@ class Head:
             if ok and p.state == "inflight":
                 p.state = "done"
                 p.ts = time.monotonic()
-                self.prefetch_completed += 1
+                if p.inline:
+                    # keep the issued/completed/wasted triple coherent
+                    # per class: inline pulls never appear in the real
+                    # speculation counters (completed > issued would
+                    # otherwise be possible)
+                    self.prefetch_completed_inline += 1
+                else:
+                    self.prefetch_completed += 1
             else:
                 self._unlink_prefetch_locked(key, p)
         if charged:
@@ -2724,11 +3077,12 @@ class Head:
                 q = self._prefetch_pending.get(node_idx)
                 if not q:
                     return
-                lease_id, ab = q.popleft()
+                lease_id, ab, inline = q.popleft()
                 self._prefetch_draining.add(node_idx)
             try:
-                issued = self._maybe_prefetch_args(lease_id, node_idx,
-                                                   [ab])
+                issued = self._maybe_prefetch_args(
+                    lease_id, node_idx, [ab],
+                    inline_ids=(ab,) if inline else ())
             finally:
                 with self._prefetch_lock:
                     self._prefetch_draining.discard(node_idx)
@@ -2763,7 +3117,10 @@ class Head:
                     self._prefetches.pop(key, None)  # list popped above
                 elif p.state == "inflight" and not p.consumed:
                     p.state = "aborted"
-                    self.prefetch_wasted += 1
+                    if p.inline:
+                        self.prefetch_wasted_inline += 1
+                    else:
+                        self.prefetch_wasted += 1
                     aborts.append(p)
                 # consumed in-flight entries: a demand fetch is riding
                 # the pull — leave it to finish; PREFETCH_RESULT (or
@@ -3561,6 +3918,13 @@ class Head:
             return [{
                 "node_idx": n.idx, "alive": n.alive,
                 "is_remote": n.is_remote, "node_ip": n.node_ip,
+                # graceful drain (r16): draining nodes take no new
+                # leases/placements/prefetches while their work moves
+                # off; drain_age_s > drain_deadline_s means the
+                # escalation wedged (doctor_warnings flags it)
+                "draining": n.draining,
+                "drain_age_s": round(now - n.drain_started, 1)
+                if n.draining else 0.0,
                 # live slow_node detector flag (r14): the node's
                 # dispatch/arg_fetch p95 skewed off the cluster median
                 # within the last slow_node_route_ttl_s — serve routers
@@ -3650,6 +4014,13 @@ class Head:
             "prefetch_wasted": self.prefetch_wasted,
             "prefetch_bytes_issued": self.prefetch_bytes_issued,
             "prefetch_inflight": self._prefetch_inflight_count(),
+            # r16: pulls of driver-tagged inline-promoted objects —
+            # real transfers, but not the speculation the waste-ratio
+            # doctor check judges (issued/completed/wasted above
+            # exclude them)
+            "prefetch_issued_inline": self.prefetch_issued_inline,
+            "prefetch_completed_inline": self.prefetch_completed_inline,
+            "prefetch_wasted_inline": self.prefetch_wasted_inline,
             # the head host's own transfer server, split by
             # source role (root = sealed copy, relay = re-served
             # in-progress partial); agent-side servers report
@@ -3731,6 +4102,18 @@ class Head:
                             "instead of re-applied",
              "tags": {}, "boundaries": None,
              "value": float(self.dedupe_hits)},
+            {"name": "head.drain_migrated_leases",
+             "kind": "counter",
+             "description": "Leases released off draining nodes while "
+                            "still alive (work migrated, not killed)",
+             "tags": {}, "boundaries": None,
+             "value": float(self.drain_migrated_leases)},
+            {"name": "head.drains_completed",
+             "kind": "counter",
+             "description": "Graceful node drains that finished with "
+                            "zero live leases (vs drains_forced)",
+             "tags": {}, "boundaries": None,
+             "value": float(self.drains_completed)},
         ]
 
     def _sq_io_loop(self, limit):
@@ -3770,6 +4153,13 @@ class Head:
                      actor_reclaims=self.actor_reclaims,
                      dedupe_hits=self.dedupe_hits,
                      restart_grace_active=bool(self._grace_until),
+                     # graceful node drain (r16)
+                     drains_started=self.drains_started,
+                     drains_completed=self.drains_completed,
+                     drains_forced=self.drains_forced,
+                     drain_migrated_leases=self.drain_migrated_leases,
+                     drain_objects_replicated=(
+                         self.drain_objects_replicated),
                      reattach_pending_workers=len(pending),
                      reattach_oldest_s=round(max(pending, default=0.0),
                                              3),
@@ -3882,6 +4272,7 @@ class Head:
             infos = [{
                 "node_idx": n.idx,
                 "alive": n.alive,
+                "draining": n.draining,
                 "resources_total": n.resources.total.to_dict(),
                 "resources_available": n.resources.available.to_dict(),
                 "store_name": n.store_name,
@@ -3893,10 +4284,12 @@ class Head:
         conn.reply(rid, infos, msg_type=P.NODE_INFO_REPLY)
 
     def _h_drain_node(self, conn, rid, node_idx):
-        with self._lock:
-            self.scheduler.drain_node(node_idx)
+        """DRAIN_NODE (r16): the full graceful-drain protocol — not just
+        the scheduler exclusion the pre-r16 handler did. See
+        ``drain_node``."""
+        ok = self.drain_node(int(node_idx))
         if rid > 0:
-            conn.reply(rid, True)
+            conn.reply(rid, ok)
 
     def _h_ping(self, conn, rid):
         conn.reply(rid, "pong")
@@ -4165,6 +4558,7 @@ class Head:
         self._retry_pending_pgs()
         self._try_fulfill_pending()
         self._sweep_prefetches()
+        self._check_drains()
         # restored actors/PGs held back by the restart grace window are
         # rescheduled here once it lifts (no-op on fresh sessions and
         # after the first post-grace flush)

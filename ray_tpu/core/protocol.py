@@ -57,7 +57,17 @@ ACTOR_DEAD = 25         # notification (actor_id_bin, err)
 KILL_ACTOR = 26         # (actor_id_bin, no_restart)
 NODE_INFO = 27          # request cluster node table
 NODE_INFO_REPLY = 28
-DRAIN_NODE = 29
+DRAIN_NODE = 29         # (node_idx,) -> ok — graceful drain (r16): the
+#                         head excludes the node from lease grants /
+#                         placements / prefetch targets, replicates its
+#                         sole-copy objects off via the pull machinery,
+#                         publishes "node_draining" so workloads migrate
+#                         proactively (pipeline stage migration), waits
+#                         for in-flight leases up to drain_deadline_s,
+#                         then fires the deliberate SHUTDOWN_NODE
+#                         removal (drain_forced past the deadline).
+#                         Reference: NodeManager::HandleDrainNode, the
+#                         autoscaler's planned-scale-down path.
 OBJECT_TRANSFER = 30    # (object_id_bin, to_node_idx) - ask head to arrange
 OBJECT_CHUNK = 31       # (object_id_bin, chunk_idx, n_chunks, payload)
 WORKER_EXIT = 32        # worker announces clean exit
@@ -187,16 +197,23 @@ PREFETCH_RESULT = 77    # agent->head, one-way: (oid_bin, node_idx, ok)
 #                         source charges it registered at issue time and
 #                         marks the entry done (ok) or drops it.
 PREFETCH_HINT = 78      # driver->head, one-way: (lease_id,
-#                         [arg_id_bins]) — dispatch-time companion to
+#                         [arg_id_bins][, [inline_id_bins]]) —
+#                         dispatch-time companion to
 #                         the grant-time prefetch: leases are long-lived
 #                         and serve many tasks, so when the submitter
 #                         pushes a task batch with by-ref args it names
 #                         them for the lease's node; the head applies
 #                         the same holder check / caps / dedupe and
 #                         fires prefetch-flagged PULL_OBJECTs while the
-#                         batch is still in flight to the worker.
+#                         batch is still in flight to the worker. The
+#                         optional third field (r16) tags the subset of
+#                         the ids that are INLINE-PROMOTED objects, so
+#                         the head books their pulls outside the
+#                         speculation waste ratio; sent only when
+#                         non-empty (common frames stay r15-identical).
 PREFETCH_HINT_BATCH = 80  # driver->head, one-way: ([(lease_key,
-#                         [arg_id_bins])],) — r15 coalesced form of
+#                         [arg_id_bins][, [inline_id_bins]])],) — r15
+#                         coalesced form of
 #                         PREFETCH_HINT: a pipeline/actor hot loop
 #                         pushing many small batches with FRESH by-ref
 #                         args (per-microbatch activations defeat the

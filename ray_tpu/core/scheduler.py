@@ -200,7 +200,13 @@ class ClusterResourceScheduler:
         if strategy.kind == "NODE_AFFINITY":
             idx = int(strategy.node_id)
             node = self.nodes.get(idx)
-            if node is None:
+            if node is None or idx in self._draining:
+                # a DRAINING node takes no new work (r16) — without
+                # this check an affinity-targeted lease would land on
+                # the departing node, hold its drain open to the
+                # deadline, and die in the forced shutdown the
+                # graceful API exists to avoid. Soft affinity falls to
+                # the policy; hard stays queued like a missing node.
                 return None if not strategy.soft else self._hybrid(request, local_idx)
             if node.is_available(request):
                 return idx

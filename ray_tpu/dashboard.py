@@ -378,6 +378,26 @@ def doctor_warnings() -> list:
             "re-placing work away from its prefetches, or "
             "arg_prefetch_max_bytes/_max_inflight are misconfigured "
             "for the workload")
+    # graceful-drain health (r16): a node still `draining` past
+    # drain_deadline_s means the force-escalation (drain_forced ->
+    # SHUTDOWN_NODE) itself wedged — the head's housekeeping thread is
+    # stuck or dead, and the node will neither finish nor be removed
+    try:
+        from ray_tpu.core.config import get_config as _gc
+
+        deadline_s = _gc().drain_deadline_s
+        for n in state.list_nodes():
+            age = n.get("drain_age_s", 0.0)
+            if n.get("draining") and age > deadline_s + 5.0:
+                warns.append(
+                    f"node {n.get('node_idx')} stuck draining for "
+                    f"{age:.0f}s (> drain_deadline_s="
+                    f"{deadline_s:g}s + escalation slack): the "
+                    "drain_forced escalation did not fire — head "
+                    "housekeeping may be wedged; remove the node "
+                    "manually or restart the head")
+    except Exception:  # noqa: BLE001 — no cluster up
+        pass
     # serve autoscaler health (r14): reads the controller's status
     # introspection; no serve running (or no controller) warns nothing
     try:

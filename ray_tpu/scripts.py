@@ -118,7 +118,12 @@ def cmd_status(args):
     avail = rt.available_resources()
     print(f"nodes: {len(nodes)}")
     for n in nodes:
-        state = "ALIVE" if n.get("alive", True) else "DEAD"
+        if n.get("draining"):
+            state = "DRAINING"
+        elif n.get("alive", True):
+            state = "ALIVE"
+        else:
+            state = "DEAD"
         print(f"  node {n['node_idx']}: {state}  "
               f"{n.get('resources_total', {})}  "
               f"workers={n.get('num_workers', 0)}")
@@ -165,6 +170,41 @@ def cmd_profile(args):
           file=sys.stderr)
     print(result["folded"])
     return 0
+
+
+def cmd_drain(args):
+    """Gracefully drain a node (r16): no new work lands on it, its
+    sole-copy objects replicate off, running leases get up to
+    ``drain_deadline_s`` to migrate/complete, then the node shuts
+    down. ``--wait`` blocks until the node leaves the table."""
+    import time as _time
+
+    from ray_tpu import state as state_api
+
+    from ray_tpu.core.config import get_config
+
+    rt = _attached(args)
+    idx = args.node_idx
+    if not rt.drain_node(idx):
+        print(f"node {idx}: unknown, already dead, or the head's "
+              "bootstrap node (node 0 cannot be drained)",
+              file=sys.stderr)
+        return 1
+    print(f"node {idx}: draining (deadline "
+          f"{get_config().drain_deadline_s:g}s)")
+    if not args.wait:
+        return 0
+    deadline = _time.monotonic() + args.timeout
+    while _time.monotonic() < deadline:
+        rows = [r for r in state_api.list_nodes()
+                if r.get("node_idx") == idx]
+        if not rows or not rows[0].get("alive"):
+            print(f"node {idx}: drained")
+            return 0
+        _time.sleep(0.5)
+    print(f"node {idx}: still draining after {args.timeout:g}s",
+          file=sys.stderr)
+    return 1
 
 
 def cmd_doctor(args):
@@ -243,6 +283,18 @@ def build_parser() -> argparse.ArgumentParser:
         "doctor",
         help="boot a 2-node cluster and smoke every dashboard endpoint")
     sp.set_defaults(fn=cmd_doctor)
+
+    sp = sub.add_parser(
+        "drain",
+        help="gracefully drain a node (migrate work + copies, then "
+             "shut it down)")
+    sp.add_argument("node_idx", type=int)
+    sp.add_argument("--wait", action="store_true",
+                    help="block until the node leaves the cluster")
+    sp.add_argument("--timeout", type=float, default=120.0,
+                    help="--wait bound, seconds")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_drain)
 
     sp = sub.add_parser("list", help="list cluster entities")
     sp.add_argument("entity", choices=["nodes", "workers", "actors",

@@ -43,7 +43,12 @@ def _apply_filters(rows: List[Dict[str, Any]],
 
 
 def list_nodes(filters=None, limit: int = _DEFAULT_LIMIT):
-    """Ref parity: ray.util.state.list_nodes (util/state/api.py:880)."""
+    """Ref parity: ray.util.state.list_nodes (util/state/api.py:880).
+    r16 adds the graceful-drain columns: ``draining`` (the node is
+    being drained — excluded from new leases/placements/prefetches
+    while its work migrates off) and ``drain_age_s`` (seconds since
+    the drain began; past ``drain_deadline_s`` the head force-escalates
+    and ``doctor_warnings()`` flags the node if it lingers)."""
     return _apply_filters(_query("nodes", limit), filters)
 
 

@@ -119,6 +119,32 @@ class _StageWorker:
         self._nmb = 0
         return True
 
+    # ------------------------------------------- elastic repair (r16)
+
+    def snapshot(self) -> dict:
+        """Stage checkpoint: params + accumulated grads + microbatch
+        count. Returned as a task result, so ``jax.Array`` leaves ride
+        the r13 typed zero-copy reducer into this node's arena and the
+        driver holds only the ref. Taken at wave boundaries (the
+        pipeline is drained there — no live per-microbatch contexts to
+        capture)."""
+        return {"stage": self.k, "params": self._stage.params,
+                "gsum": self._gsum, "nmb": self._nmb}
+
+    def restore(self, snap: dict):
+        """Roll this stage back to a snapshot's wave boundary. On a
+        REPLACEMENT actor this loads the dead predecessor's state; on a
+        surviving actor it rewinds grads accumulated by the aborted
+        wave. Per-actor seqno order makes the driver's restore an
+        implicit quiescence barrier: it runs only after every
+        already-submitted wave task on this actor finished (or
+        errored)."""
+        self._stage.params = snap["params"]
+        self._gsum = snap["gsum"]
+        self._nmb = snap["nmb"]
+        self._ctx.clear()
+        return True
+
     # -------------------------------------------------- schedule ops
 
     def fwd(self, x, mb: int, target=None):
@@ -226,6 +252,52 @@ def _check_batch(microbatches, targets, jax_mode: bool,
             else [None] * len(microbatches))
 
 
+def plan_repair(dead_stages: Sequence[int], stage_nodes: Sequence[int],
+                alive_nodes: Sequence[int], ckpt_wave: int,
+                failed_wave: int, wave_sizes: Sequence[int]) -> dict:
+    """Pure, deterministic repair plan for a pipeline whose stage(s)
+    died with their node (r16) — factored out of ``Pipeline._repair``
+    so the placement choice / checkpoint-wave selection / replay set
+    are unit-testable without chaos.
+
+    - **re-placement**: each dead stage (ascending) goes to the alive
+      node hosting the FEWEST stages (surviving stages plus earlier
+      re-placements in this same plan), ties broken by lowest node
+      index — the gang stays as spread as the surviving cluster
+      allows, and the choice is a pure function of its inputs.
+    - **checkpoint-wave selection**: restore to ``ckpt_wave`` (the
+      latest wave boundary every stage holds a snapshot for; -1 = the
+      batch-start snapshot).
+    - **replay set**: waves ``ckpt_wave+1 .. failed_wave`` inclusive —
+      everything since the restored boundary, nothing before it.
+
+    ``stage_nodes[k]`` is stage k's node before the failure (dead
+    stages' entries are ignored); ``wave_sizes[w]`` the microbatch
+    count of wave w. Returns ``{placement: {stage: node}, restore_wave,
+    replay_waves, redo_microbatches}``. Raises when no node survives.
+    """
+    alive = sorted(set(alive_nodes))
+    if not alive:
+        raise ValueError("no surviving node to re-place stages on")
+    dead = set(dead_stages)
+    hosted = {n: 0 for n in alive}
+    for k, n in enumerate(stage_nodes):
+        if k not in dead and n in hosted:
+            hosted[n] += 1
+    placement: Dict[int, int] = {}
+    for k in sorted(dead):
+        target = min(alive, key=lambda n: (hosted[n], n))
+        placement[k] = target
+        hosted[target] += 1
+    replay = list(range(ckpt_wave + 1, failed_wave + 1))
+    return {
+        "placement": placement,
+        "restore_wave": ckpt_wave,
+        "replay_waves": replay,
+        "redo_microbatches": sum(wave_sizes[w] for w in replay),
+    }
+
+
 class Pipeline:
     """Driver handle: builds the stage gang, runs schedules.
 
@@ -254,31 +326,111 @@ class Pipeline:
         cfg = get_config()
         self.num_stages = len(stages)
         self.schedule = schedule
+        self._stages = list(stages)
         self._loss_fn = loss_fn
         self._jax_mode = _uniform_mode(stages)
         self._bound = (cfg.pipeline_max_inflight_microbatches
                        if max_inflight_microbatches is None
                        else max_inflight_microbatches)
+        self._num_cpus_per_stage = num_cpus_per_stage
         self._pg = None
+        # ---- elastic repair state (r16) ----
+        # latest per-stage checkpoint refs + the wave boundary they
+        # capture (-1 = batch start); exactly ONE generation is held —
+        # taking a new checkpoint drops the old refs, so the owner free
+        # reclaims them eagerly (O(stages) footprint, same discipline
+        # as activations)
+        self._ckpt: Dict[int, Any] = {}
+        self._ckpt_wave = -1
+        #: stage k -> node idx it currently runs on (refreshed lazily)
+        self.stage_nodes: Optional[List[int]] = None
+        #: node idxs the head announced as draining (pubsub); pruned
+        #: when the node is removed
+        self._draining_nodes: set = set()
+        self._drain_subs: List[tuple] = []  # (channel, handler) pairs
+        #: repair events absorbed (bounded by pipeline_max_repairs)
+        self.pipeline_repairs = 0
+        #: microbatches re-run because of repairs (the chaos gate
+        #: asserts this stays <= one checkpoint interval of waves)
+        self.repair_redo_microbatches = 0
+        #: stages proactively moved off draining nodes (zero-redo path)
+        self.stage_migrations = 0
         strategies = self._resolve_placement(
             placement or cfg.pipeline_stage_placement,
             num_cpus_per_stage, pg_timeout_s)
-        actor_cls = ray_tpu.remote(_StageWorker)
-        self.actors = []
-        for k, stage in enumerate(stages):
-            opts = {"num_cpus": num_cpus_per_stage}
-            if strategies[k] is not None:
-                opts["scheduling_strategy"] = strategies[k]
-            self.actors.append(actor_cls.options(**opts).remote(
-                k, self.num_stages, stage,
-                loss_fn if k == self.num_stages - 1 else None))
+        self._actor_cls = ray_tpu.remote(_StageWorker)
+        self.actors = [self._spawn_stage(k, strategies[k])
+                       for k in range(self.num_stages)]
+        self._subscribe_drain_events()
+
+    def _spawn_stage(self, k: int, strategy=None):
+        """Create stage k's actor (construction and repair share it)."""
+        opts: Dict[str, Any] = {"num_cpus": self._num_cpus_per_stage}
+        if strategy is not None:
+            opts["scheduling_strategy"] = strategy
+        return self._actor_cls.options(**opts).remote(
+            k, self.num_stages, self._stages[k],
+            self._loss_fn if k == self.num_stages - 1 else None)
+
+    def _subscribe_drain_events(self):
+        """Track head drain announcements so wave boundaries can
+        migrate stages off a departing node BEFORE its shutdown (the
+        graceful half of elastic repair — zero failed tasks, zero
+        redo). Fire-and-forget one-way subscriptions; a pipeline built
+        before any drain still catches later announcements, and
+        ``_migrate_draining_stages(refresh=True)`` re-seeds from the
+        node table at batch start in case the subscription raced one."""
+        import weakref
+
+        from ray_tpu.core.context import get_context_if_exists
+
+        ctx = get_context_if_exists()
+        if ctx is None:  # pure-unit usage (schedule tests): no runtime
+            return
+        # weakly bound: pubsub handlers are never unsubscribed, and a
+        # strong bound method would pin every Pipeline ever built
+        wself = weakref.ref(self)
+
+        def on_draining(idx, w=wself):
+            p = w()
+            if p is not None:
+                p._on_node_draining(idx)
+
+        def on_removed(idx, w=wself):
+            p = w()
+            if p is not None:
+                p._on_node_removed(idx)
+
+        try:
+            ctx.subscribe("node_draining", on_draining, ack=False)
+            ctx.subscribe("node_removed", on_removed, ack=False)
+            # remembered so shutdown() can drop them — handler lists
+            # would otherwise grow by two per Pipeline ever built
+            self._drain_subs = [("node_draining", on_draining),
+                                ("node_removed", on_removed)]
+        except Exception:  # noqa: BLE001 — head outage: batch-start
+            pass           # refresh still sees the draining flags
+
+    def _on_node_draining(self, idx):
+        try:
+            self._draining_nodes.add(int(idx))
+        except (TypeError, ValueError):
+            pass
+
+    def _on_node_removed(self, idx):
+        try:
+            self._draining_nodes.discard(int(idx))
+        except (TypeError, ValueError):
+            pass
 
     def _resolve_placement(self, mode: str, num_cpus: int,
                            pg_timeout_s: float) -> list:
         S = self.num_stages
         if mode == "auto":
+            # draining nodes are departing — never pin a fresh stage
+            # onto one (r16)
             alive = sorted(n["node_idx"] for n in ray_tpu.nodes()
-                           if n.get("alive"))
+                           if n.get("alive") and not n.get("draining"))
             if len(alive) <= 1:
                 return [None] * S
             # soft pinning: a stage whose node fills up may still land
@@ -313,21 +465,88 @@ class Pipeline:
         the mean per-microbatch loss in jax mode (None in raw mode);
         ``outputs`` are the last stage's forward results (loss refs in
         jax mode, raw forwards' returns otherwise), already resolved
-        for jax mode."""
+        for jax mode.
+
+        **Elastic repair (r16).** With
+        ``pipeline_checkpoint_every_waves > 0`` every stage snapshots
+        params + accumulated grads at wave boundaries (by-ref, replica
+        secured off the producing node), and a stage's NODE DEATH
+        mid-batch is absorbed: the dead stage is re-placed on a
+        surviving node (checkpoint pre-warmed under the actor spawn),
+        every stage restores to the latest checkpointed boundary, and
+        only the waves since it replay — redo bounded by the
+        checkpoint interval. Wave boundaries also migrate stages off
+        DRAINING nodes proactively (zero redo). Losses/grads of a
+        repaired batch equal the no-fault run; raw-mode ``outputs``
+        from pre-crash waves may be lost when they lived on the dead
+        node (jax-mode losses are inline and always survive)."""
         tgts = _check_batch(microbatches, targets, self._jax_mode,
                             self._loss_fn)
         M = len(microbatches)
-        out_refs: List[Any] = []
         bound = self._bound
         wave = M if bound <= 0 else min(bound, M)
         # a positive bound runs the batch in WAVES of at most `bound`
         # microbatches — at no point are more than `bound` in flight
         # (grads keep accumulating across waves, so results are
         # unchanged; each wave boundary drains the pipeline)
-        for off in range(0, M, wave):
-            out_refs.extend(self._run_wave(
-                microbatches[off:off + wave], tgts[off:off + wave],
-                off, by_ref_min_bytes))
+        waves = [(off, list(microbatches[off:off + wave]),
+                  tgts[off:off + wave])
+                 for off in range(0, M, wave)]
+        cfg = get_config()
+        every = cfg.pipeline_checkpoint_every_waves
+        elastic = every > 0
+        out_refs: List[Any] = [None] * M
+        if elastic:
+            self._migrate_draining_stages(refresh=True)
+            # wave indices are PER BATCH: the previous batch's
+            # checkpoint generation is invalid here (its grads belong
+            # to that batch's boundary, and its wave tag would compute
+            # a bogus replay set) — drop it before snapshotting fresh.
+            # If the batch-start snapshot itself fails there is NO
+            # valid restore point for this batch: fall back to the
+            # pre-r16 fail-fast semantics instead of "repairing" to a
+            # foreign boundary.
+            self._ckpt = {}
+            self._ckpt_wave = -1
+            elastic = self._take_checkpoint(-1)
+        wi = 0
+        while wi < len(waves):
+            off, mbs_w, tgts_w = waves[wi]
+            try:
+                refs = self._run_wave(mbs_w, tgts_w, off,
+                                      by_ref_min_bytes)
+            except Exception as err:  # noqa: BLE001 — repair filter below
+                if not elastic:
+                    raise
+                max_repairs = get_config().pipeline_max_repairs
+                replay_from = None
+                attempt_err: Optional[Exception] = err
+                attempts = 0
+                # a SECOND death while the repair itself runs (during
+                # restore/spawn) re-enters the repair against the new
+                # failure instead of escaping with budget left; the
+                # attempt bound stops a cluster dying node-by-node
+                # from looping forever
+                while attempt_err is not None and \
+                        attempts < max_repairs and \
+                        self.pipeline_repairs < max_repairs:
+                    attempts += 1
+                    try:
+                        replay_from = self._repair(attempt_err, waves,
+                                                   wi)
+                        attempt_err = None
+                    except Exception as e2:  # noqa: BLE001
+                        attempt_err = e2
+                if replay_from is None:
+                    raise
+                wi = replay_from
+                continue
+            out_refs[off:off + len(refs)] = refs
+            wi += 1
+            if elastic and wi < len(waves) and \
+                    (wi - 1) - self._ckpt_wave >= every:
+                self._migrate_draining_stages()
+                self._take_checkpoint(wi - 1)
         result = {"loss": None, "per_mb_losses": None,
                   "outputs": out_refs}
         if self._jax_mode and self._loss_fn is not None:
@@ -418,6 +637,268 @@ class Pipeline:
             return ray_tpu.put(x)
         return x
 
+    # ------------------------------------------- elastic repair (r16)
+
+    def _take_checkpoint(self, wave_idx: int) -> bool:
+        """Snapshot every stage at a drained wave boundary. The refs
+        are held driver-side tagged by ``wave_idx``; sole plasma copies
+        are replicated off their producing node (a node kill must not
+        take the only copy with it); the PREVIOUS generation's refs are
+        dropped — eager free, O(stages) checkpoint footprint. A failed
+        snapshot (stage died mid-checkpoint) keeps the previous
+        generation: the following wave's failure then repairs from the
+        older boundary — more redo, same correctness."""
+        import threading
+
+        from ray_tpu.core.context import get_context
+
+        refs = [a.snapshot.options(
+            name=f"{self.name_prefix}stage{k}.ckpt").remote()
+            for k, a in enumerate(self.actors)]
+        ready, rest = ray_tpu.wait(refs, num_returns=len(refs),
+                                   timeout=300)
+        ctx = get_context()
+        if rest or any(
+                (e := ctx.memory_store.peek(r.id)) is None or e.is_error
+                for r in refs):
+            return False
+        # the generation swaps in only when EVERY snapshot is secured:
+        # a ref whose off-node replication failed would hold its sole
+        # copy on the very node a repair needs it to outlive — keeping
+        # the previous (secured) generation costs redo, never
+        # correctness
+        secured = [False] * len(refs)
+
+        def _sec(i, r):
+            secured[i] = self._secure_checkpoint(r)
+
+        ts = [threading.Thread(target=_sec, args=(i, r), daemon=True)
+              for i, r in enumerate(refs)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        if not all(secured):
+            return False
+        self._ckpt = dict(enumerate(refs))
+        self._ckpt_wave = wave_idx
+        return True
+
+    def _secure_checkpoint(self, ref) -> bool:
+        """Replicate a plasma-resident snapshot into the driver's arena
+        (directory-registered second holder) so it survives the
+        producing node; returns whether an off-node copy now exists.
+        Inline snapshots (tiny params/grads) already live in driver
+        memory and need nothing."""
+        from ray_tpu.core import protocol as P
+        from ray_tpu.core.context import get_context
+
+        ctx = get_context()
+        e = ctx.memory_store.peek(ref.id)
+        if e is None or e.is_error:
+            return False
+        if not e.in_plasma or e.node_idx == ctx.node_idx:
+            return True  # inline value / already driver-resident
+        try:
+            ctx.head.call(P.OBJECT_TRANSFER, ref.id.binary(),
+                          ctx.node_idx, timeout=120)
+            return True
+        except Exception:  # noqa: BLE001 — primary copy still serves
+            return False   # ... but is not crash-safe: not secured
+
+    def _dead_stages(self, wait_s: float = 10.0) -> List[int]:
+        """Stages whose actor the driver has marked DEAD (the
+        ``CoreContext.actor_state`` view — the same signal that fails
+        pending calls with ``ActorDiedError``). Polled for up to
+        ``wait_s``: a wave failure may surface (e.g. as a failed
+        activation fetch) moments before the head's actor-death
+        notification lands."""
+        import time as _time
+
+        from ray_tpu.core.context import get_context
+
+        ctx = get_context()
+        deadline = _time.monotonic() + wait_s
+        while True:
+            dead = [k for k, a in enumerate(self.actors)
+                    if ctx.actor_state(a._actor_id) == "DEAD"]
+            if dead or _time.monotonic() > deadline:
+                return dead
+            _time.sleep(0.2)
+
+    def _alive_node_idxs(self) -> List[int]:
+        return sorted(n["node_idx"] for n in ray_tpu.nodes()
+                      if n.get("alive") and not n.get("draining"))
+
+    def _repair(self, err: Exception, waves, failed_wi: int
+                ) -> Optional[int]:
+        """Node-death re-gang: re-place dead stages on surviving nodes,
+        restore EVERY stage to the latest checkpointed wave boundary,
+        and return the first wave index to replay — or None when the
+        failure is not a stage death, in which case the caller
+        re-raises ``err``. The `pipeline_max_repairs` budget is
+        enforced by the caller's retry loop and consumed only when a
+        repair COMPLETES (a repair interrupted by a further death
+        re-enters with its budget intact)."""
+        from ray_tpu.core.api import NodeAffinitySchedulingStrategy
+        from ray_tpu.core.events import emit_cluster_event
+        from ray_tpu.core.exceptions import (
+            ActorDiedError, ActorUnavailableError, GetTimeoutError,
+            ObjectLostError, WorkerCrashedError)
+
+        # only death-shaped failures are worth the detection poll — an
+        # ordinary error (user bug in a stage fn surfacing as a task
+        # error) gets ONE immediate check and re-raises promptly
+        # instead of stalling 10s on every legitimate failure
+        deathlike = isinstance(err, (
+            ActorDiedError, ActorUnavailableError, WorkerCrashedError,
+            ObjectLostError, GetTimeoutError))
+        dead = self._dead_stages(wait_s=10.0 if deathlike else 0.0)
+        if not dead:
+            return None
+        self._refresh_stage_nodes(skip=set(dead))
+        plan = plan_repair(dead, self.stage_nodes or [],
+                           self._alive_node_idxs(), self._ckpt_wave,
+                           failed_wi, [len(w[1]) for w in waves])
+        for k, target in sorted(plan["placement"].items()):
+            ck = self._ckpt.get(k)
+            if ck is not None:
+                # overlap the checkpoint pull with the actor spawn:
+                # no-op for head-local targets (same-host arenas)
+                ray_tpu.warm_object(ck, node_idx=target)
+            self.actors[k] = self._spawn_stage(
+                k, NodeAffinitySchedulingStrategy(target, soft=True))
+        # restore ALL stages — survivors rewind the aborted wave's
+        # partial grad contributions; per-actor seqno order makes each
+        # restore an implicit quiescence barrier behind the wave's
+        # already-submitted tasks
+        restores = []
+        for k, a in enumerate(self.actors):
+            name = f"{self.name_prefix}stage{k}.restore"
+            ck = self._ckpt.get(k)
+            restores.append(
+                a.reset.options(name=name).remote() if ck is None
+                else a.restore.options(name=name).remote(ck))
+        ray_tpu.get(restores, timeout=300)
+        self._refresh_stage_nodes()
+        redo = plan["redo_microbatches"]
+        # budget and counters move only on a COMPLETED repair — an
+        # attempt interrupted by a further death re-enters with its
+        # budget intact (the caller bounds total attempts)
+        self.pipeline_repairs += 1
+        self.repair_redo_microbatches += redo
+        emit_cluster_event(
+            "WARNING", "pipeline", "pipeline_stage_repaired",
+            f"re-placed dead stage(s) {sorted(dead)} on "
+            f"{plan['placement']}, restored to wave "
+            f"{plan['restore_wave']}, replaying {redo} microbatches",
+            extra={"stages": sorted(dead),
+                   "placement": {str(k): v for k, v in
+                                 plan["placement"].items()},
+                   "restore_wave": plan["restore_wave"],
+                   "redo_microbatches": redo,
+                   "cause": repr(err)[:200]})
+        return plan["restore_wave"] + 1
+
+    def _migrate_draining_stages(self, refresh: bool = False) -> int:
+        """Graceful-drain half of elastic repair: at a wave boundary
+        (pipeline drained — no in-flight stage tasks), move every stage
+        hosted by a DRAINING node onto a surviving one — snapshot,
+        spawn, warm, restore, retire — so the head's drain completes
+        with zero failed tasks and zero redo. Returns stages moved."""
+        from ray_tpu.core.api import NodeAffinitySchedulingStrategy
+        from ray_tpu.core.events import emit_cluster_event
+
+        if refresh:
+            try:
+                for n in ray_tpu.nodes():
+                    if n.get("draining"):
+                        self._draining_nodes.add(n["node_idx"])
+            except Exception:  # noqa: BLE001 — head outage: skip
+                return 0
+        draining = set(self._draining_nodes)
+        if not draining:
+            return 0
+        self._refresh_stage_nodes()
+        victims = [k for k, n in enumerate(self.stage_nodes or [])
+                   if n in draining]
+        if not victims:
+            return 0
+        alive = [n for n in self._alive_node_idxs()
+                 if n not in draining]
+        if not alive:
+            return 0  # nowhere to go: the head's deadline decides
+        plan = plan_repair(victims, self.stage_nodes, alive, 0, -1, [])
+        moved = 0
+        for k in victims:
+            target = plan["placement"][k]
+            name = f"{self.name_prefix}stage{k}"
+            old = self.actors[k]
+            # mid-batch grads ride the snapshot; the wave boundary
+            # guarantees no live contexts
+            snap = old.snapshot.options(name=f"{name}.ckpt").remote()
+            new = self._spawn_stage(
+                k, NodeAffinitySchedulingStrategy(target, soft=True))
+            ray_tpu.wait([snap], num_returns=1, timeout=300)
+            ray_tpu.warm_object(snap, node_idx=target)
+            try:
+                ray_tpu.get([new.restore.options(
+                    name=f"{name}.restore").remote(snap)], timeout=300)
+            except Exception:  # noqa: BLE001 — replacement failed:
+                # keep the old actor (the crash path repairs if the
+                # drain escalates to a kill) and retire the orphaned
+                # replacement — it would otherwise strand a CPU a
+                # later repair needs
+                try:
+                    ray_tpu.kill(new)
+                except Exception:  # noqa: BLE001
+                    pass
+                continue
+            self.actors[k] = new
+            try:
+                ray_tpu.kill(old)
+            except Exception:  # noqa: BLE001
+                pass
+            moved += 1
+            self.stage_migrations += 1
+            emit_cluster_event(
+                "INFO", "pipeline", "pipeline_stage_migrated",
+                f"stage {k} migrated off draining node "
+                f"{(self.stage_nodes or [None] * (k + 1))[k]} "
+                f"to node {target}",
+                extra={"stage": k, "to_node": target})
+        if moved:
+            self._refresh_stage_nodes()
+        return moved
+
+    def _refresh_stage_nodes(self, skip: Optional[set] = None) -> None:
+        """Re-learn which node hosts each stage (placement is soft, so
+        truth lives with the actors). ``skip`` names stages known dead
+        — their last-known entry is kept for the planner's host load
+        accounting of SURVIVORS only."""
+        skip = skip or set()
+        nodes = list(self.stage_nodes or [-1] * self.num_stages)
+        probes = {k: self.actors[k].probe.remote()
+                  for k in range(self.num_stages) if k not in skip}
+        for k, ref in probes.items():
+            try:
+                nodes[k] = ray_tpu.get([ref], timeout=120)[0]["node_idx"]
+            except Exception:  # noqa: BLE001 — died since: keep stale
+                pass
+        self.stage_nodes = nodes
+
+    def stats(self) -> dict:
+        """Elastic-repair counters (the chaos/drain gates read these;
+        they also ride the cluster event log as
+        ``pipeline_stage_repaired`` / ``pipeline_stage_migrated``)."""
+        return {
+            "pipeline_repairs": self.pipeline_repairs,
+            "repair_redo_microbatches": self.repair_redo_microbatches,
+            "stage_migrations": self.stage_migrations,
+            "checkpoint_wave": self._ckpt_wave,
+            "checkpointed_stages": len(self._ckpt),
+        }
+
     # ---------------------------------------------------- gang state
 
     def grads(self, mean: bool = True) -> list:
@@ -440,6 +921,14 @@ class Pipeline:
             except Exception:  # noqa: BLE001
                 pass
         self.actors = []
+        self._ckpt = {}  # drop checkpoint refs -> eager owner free
+        from ray_tpu.core.context import get_context_if_exists
+
+        ctx = get_context_if_exists()
+        if ctx is not None:
+            for channel, handler in self._drain_subs:
+                ctx.unsubscribe(channel, handler)
+        self._drain_subs = []
         if self._pg is not None:
             try:
                 ray_tpu.remove_placement_group(self._pg)

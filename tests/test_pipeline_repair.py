@@ -1,0 +1,571 @@
+"""Elastic pipeline repair (r16): repair-planner units, the
+actor-death surface the planner relies on, graceful node drain, and
+inline-promoted prefetch-hint tagging.
+
+Layers:
+- pure units: ``plan_repair`` (deterministic re-placement choice,
+  checkpoint-wave selection, replay set) and the doctor stuck-drain
+  warning;
+- virtual-cluster integration: a killed actor's pending callers get a
+  prompt ``ActorDiedError`` (not a hang); a mid-batch node kill is
+  absorbed by the pipeline with redo <= one wave; tier-1 drain smoke
+  (draining -> gone, ``node_drained`` event, zero failed tasks, copies
+  fetchable from survivors);
+- recorder-head units: inline-promoted arg ids ride the hint wire
+  tagged, and the head books their pulls outside the issued/wasted
+  speculation counters;
+- chaos (slow tier): kill -9 of a real agent node mid-1F1B — grads
+  equal the no-fault oracle; graceful drain of a live stage's node —
+  zero failed tasks, ``drain_migrated_leases`` >= 1.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu.core.api import NodeAffinitySchedulingStrategy
+from ray_tpu.train import pipeline as pl
+
+
+# ===================================================== planner units
+
+
+class TestPlanRepair:
+    def test_deterministic_replacement_choice(self):
+        """3 virtual nodes, stage k on node k, stage 1's node died:
+        the survivors host one stage each — the tie breaks to the
+        LOWEST node index, and repeated planning is identical."""
+        plan = pl.plan_repair([1], [0, 1, 2], [0, 2], ckpt_wave=-1,
+                              failed_wave=0, wave_sizes=[4, 4])
+        assert plan["placement"] == {1: 0}
+        again = pl.plan_repair([1], [0, 1, 2], [0, 2], ckpt_wave=-1,
+                               failed_wave=0, wave_sizes=[4, 4])
+        assert again == plan
+
+    def test_least_loaded_spread_for_colocated_stages(self):
+        """Two stages died with one node: they re-place least-loaded-
+        first, spreading over the survivors instead of stacking."""
+        plan = pl.plan_repair([1, 2], [0, 1, 1], [0, 2], ckpt_wave=0,
+                              failed_wave=1, wave_sizes=[2, 2])
+        # node 0 hosts stage 0 already -> stage 1 goes to empty node 2,
+        # stage 2 then ties (1 each) and breaks to node 0
+        assert plan["placement"] == {1: 2, 2: 0}
+
+    def test_checkpoint_wave_selection_and_replay_set(self):
+        plan = pl.plan_repair([0], [0, 1], [1], ckpt_wave=1,
+                              failed_wave=3,
+                              wave_sizes=[4, 4, 4, 4])
+        assert plan["restore_wave"] == 1
+        assert plan["replay_waves"] == [2, 3]
+        assert plan["redo_microbatches"] == 8
+        # batch-start checkpoint: everything replays
+        plan = pl.plan_repair([0], [0, 1], [1], ckpt_wave=-1,
+                              failed_wave=1, wave_sizes=[3, 3])
+        assert plan["replay_waves"] == [0, 1]
+        assert plan["redo_microbatches"] == 6
+
+    def test_no_surviving_node_raises(self):
+        with pytest.raises(ValueError, match="no surviving node"):
+            pl.plan_repair([0], [0], [], ckpt_wave=-1, failed_wave=0,
+                           wave_sizes=[1])
+
+
+def test_doctor_flags_stuck_drain(monkeypatch, ray_start):
+    """A node still `draining` past drain_deadline_s (+ escalation
+    slack) means drain_forced never fired — doctor must flag it; a
+    fresh drain inside the window must not."""
+    from ray_tpu.core.config import get_config
+    from ray_tpu.dashboard import doctor_warnings
+
+    deadline = get_config().drain_deadline_s
+    rows = [{"node_idx": 7, "alive": True, "draining": True,
+             "drain_age_s": deadline + 30.0}]
+    monkeypatch.setattr(state, "list_nodes", lambda *a, **k: rows)
+    warns = [w for w in doctor_warnings() if "stuck draining" in w]
+    assert len(warns) == 1 and "node 7" in warns[0], warns
+    rows[0]["drain_age_s"] = deadline * 0.5
+    assert not [w for w in doctor_warnings() if "stuck draining" in w]
+
+
+# ============================================= actor-death surface
+
+
+class _Svc:
+    def ping(self):
+        return "pong"
+
+    def slow(self, s):
+        time.sleep(s)
+        return s
+
+
+def test_killed_actor_surfaces_actor_died_not_hang(ray_start_cluster):
+    """The surface the repair planner relies on: when an actor's node
+    is removed, pending callers — both the in-flight call and tasks
+    queued behind it — get a prompt ActorDiedError instead of hanging
+    to their timeout (the deliberate-kill path pre-marks workers dead,
+    which used to suppress the actor-death notification entirely)."""
+    cluster = ray_start_cluster
+    idx = cluster.add_node(num_cpus=2)
+    a = ray_tpu.remote(_Svc).options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(idx)).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    inflight = a.slow.remote(30.0)
+    queued = a.slow.remote(0.1)
+    # a caller ALREADY blocked in get() must unblock with the error too
+    blocked_err = {}
+
+    def blocked_get():
+        try:
+            ray_tpu.get(queued, timeout=25)
+        except Exception as e:  # noqa: BLE001
+            blocked_err["e"] = e
+
+    t = threading.Thread(target=blocked_get, daemon=True)
+    t.start()
+    time.sleep(0.5)
+    cluster.remove_node(idx)
+    t0 = time.monotonic()
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(inflight, timeout=20)
+    assert time.monotonic() - t0 < 10.0, "death was not prompt"
+    t.join(timeout=10)
+    assert isinstance(blocked_err.get("e"), ray_tpu.ActorDiedError), \
+        blocked_err
+
+
+# ======================================= virtual-cluster repair/drain
+
+
+def _mk_raw_stages(n_stages, fwd_s=0.0):
+    def fwd_mid(params, x):
+        if fwd_s:
+            time.sleep(fwd_s)
+        a = x if isinstance(x, np.ndarray) else np.full(
+            70000, float(x), np.float32)
+        return a + 1.0, None
+
+    def fwd_last(params, x):
+        if fwd_s:
+            time.sleep(fwd_s)
+        return float(np.asarray(x).ravel()[0]), None
+
+    def bwd_mid(params, saved, g):
+        return None, (g if isinstance(g, np.ndarray)
+                      else np.ones(70000, np.float32))
+
+    def bwd_first(params, saved, g):
+        return None, None
+
+    stages = []
+    for k in range(n_stages):
+        stages.append(pl.PipelineStage(
+            fwd=fwd_last if k == n_stages - 1 else fwd_mid,
+            bwd=bwd_first if k == 0 else bwd_mid))
+    return stages
+
+
+def test_pipeline_repairs_node_kill_virtual(ray_start_cluster):
+    """Mid-batch kill of a stage's (virtual) node: the pipeline
+    re-places the stage on a surviving node, restores the wave-boundary
+    checkpoint, replays <= one wave, and the batch completes with
+    correct outputs — one pipeline_stage_repaired event rides the
+    cluster log."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    pipe = pl.Pipeline(_mk_raw_stages(3, fwd_s=0.25), schedule="1f1b",
+                       max_inflight_microbatches=3)
+    pipe._refresh_stage_nodes()
+    assert len(set(pipe.stage_nodes)) == 3, pipe.stage_nodes
+    victim = pipe.stage_nodes[1]
+    out = {}
+
+    def run():
+        out["res"] = pipe.run_batch([float(i) for i in range(6)],
+                                    by_ref_min_bytes=0)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(2.2)  # into the first wave
+    cluster.remove_node(victim)
+    t.join(timeout=90)
+    assert not t.is_alive(), "repair did not complete"
+    vals = ray_tpu.get(out["res"]["outputs"], timeout=60)
+    assert vals == [float(i) + 2.0 for i in range(6)], vals
+    st = pipe.stats()
+    assert st["pipeline_repairs"] == 1, st
+    assert 0 < st["repair_redo_microbatches"] <= 3, st
+    assert victim not in (pipe.stage_nodes or []), pipe.stage_nodes
+    evs = state.list_cluster_events(
+        filters=[("type", "=", "pipeline_stage_repaired")])
+    assert len(evs) == 1 and evs[0]["extra"]["stages"] == [1], evs
+    pipe.shutdown()
+
+
+def test_drain_node_tier1_smoke(ray_start_cluster):
+    """Tier-1 drain smoke: drain a 2nd node whose only occupants are
+    an idle actor's lease and a sole object copy — the nodes row shows
+    `draining` (excluded from new placements), the sole copy
+    replicates off and stays fetchable, retiring the actor completes
+    the drain (node_drained, NOT drain_forced), the row goes away, and
+    no task failed."""
+    cluster = ray_start_cluster
+    idx = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote
+    def make(n):
+        return np.full(n, 7.0, np.float32)
+
+    # a plasma-resident object whose only copy lives on the 2nd node
+    ref = make.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(idx)).remote(
+        70000)
+    assert ray_tpu.get(ref, timeout=30).shape == (70000,)
+    assert idx in ray_tpu.object_locations(ref)["holders"]
+    # an actor lease pins the node mid-drain so the draining state is
+    # observable (an empty node drains within a housekeeping tick)
+    a = ray_tpu.remote(_Svc).options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(idx)).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    # the head's bootstrap node is never drainable (its removal would
+    # take the driver's own arena down with it)
+    assert ray_tpu.drain_node(0) is False
+    assert ray_tpu.drain_node(idx) is True
+    rows = [r for r in state.list_nodes() if r["node_idx"] == idx]
+    assert rows and rows[0]["draining"] is True, rows
+    # still listed, still alive: the lease holds the shutdown back
+    time.sleep(1.0)
+    rows = [r for r in state.list_nodes() if r["node_idx"] == idx]
+    assert rows and rows[0]["alive"], rows
+    ray_tpu.kill(a)  # retire the occupant -> the drain can complete
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        rows = [r for r in state.list_nodes() if r["node_idx"] == idx]
+        if not rows:
+            break
+        time.sleep(0.25)
+    assert not rows, f"node {idx} never finished draining: {rows}"
+    types = [e["type"] for e in state.list_cluster_events()]
+    assert "node_draining" in types and "node_drained" in types, types
+    assert "drain_forced" not in types, types
+    io = state.io_loop_stats()[0]
+    assert io["drains_completed"] >= 1 and io["drains_forced"] == 0, io
+    assert io["drain_migrated_leases"] >= 1, io
+    # the drained node's sole copy replicated off and is still served
+    locs = ray_tpu.object_locations(ref)
+    assert locs["holders"] and idx not in locs["holders"], locs
+    got = ray_tpu.get(ref, timeout=30)
+    assert float(got[0]) == 7.0 and got.shape == (70000,)
+    # zero failed tasks attributable to the drain
+    failed = [r for r in state.list_tasks(limit=1000)
+              if r["state"] == "FAILED"]
+    assert not failed, failed
+
+
+# ====================================== inline-promoted hint tagging
+
+
+class _RecorderConn:
+    """Stands in for a head/agent channel, recording sends."""
+
+    def __init__(self):
+        self.sent = []
+
+    def is_attached(self):
+        return True
+
+    def send(self, mt, *fields, **kw):
+        self.sent.append((mt, fields))
+
+
+class TestInlineHintTagging:
+    def _fake_batch(self, *ids):
+        from ray_tpu.core.task_spec import ARG_REF
+
+        class _Spec:
+            def __init__(self, args):
+                self.args = args
+
+        return [_Spec([(ARG_REF, i, "owner") for i in ids])]
+
+    def test_driver_tags_inline_promoted_ids(self, ray_start):
+        """Hints carry the optional third field naming which ids are
+        inline-promoted; frames with no inline ids stay 2-field
+        (byte-identical to r15)."""
+        from types import SimpleNamespace
+
+        from ray_tpu.core import protocol as P
+        from ray_tpu.core.context import get_context
+
+        ctx = get_context()
+        rec = _RecorderConn()
+        real_head = ctx.head
+        ctx.head = rec
+        inline_id, real_id = b"i" * 16, b"r" * 16
+        try:
+            with ctx._hint_lock:
+                ctx._hint_buf.clear()
+                ctx._inline_promoted[inline_id] = None
+            ctx._send_prefetch_hint(
+                SimpleNamespace(hinted=None),
+                self._fake_batch(inline_id, real_id), "lease-x")
+            ctx._flush_prefetch_hints()
+            assert len(rec.sent) == 1
+            mt, fields = rec.sent[0]
+            assert mt == P.PREFETCH_HINT
+            assert fields == ("lease-x", [inline_id, real_id],
+                              [inline_id])
+            # no-inline destinations keep the 2-field r15 frame
+            rec.sent.clear()
+            ctx._send_prefetch_hint(
+                SimpleNamespace(hinted=None),
+                self._fake_batch(real_id), "lease-y")
+            ctx._flush_prefetch_hints()
+            assert rec.sent[0] == (P.PREFETCH_HINT,
+                                   ("lease-y", [real_id]))
+        finally:
+            with ctx._hint_lock:
+                ctx._inline_promoted.pop(inline_id, None)
+            ctx.head = real_head
+
+    def test_promote_if_needed_records_id(self, ray_start):
+        """An owner value materialized by _promote_if_needed lands in
+        the inline-promoted set the hint tagger reads (put() objects
+        are plasma-resident from birth and are NOT tagged)."""
+        from ray_tpu.core.context import get_context
+
+        ctx = get_context()
+
+        @ray_tpu.remote
+        def tiny():
+            return 123  # inline-sized return: lives in driver memory
+
+        ref = tiny.remote()
+        assert ray_tpu.get(ref, timeout=30) == 123
+        assert ref.id.binary() not in ctx._inline_promoted
+        ctx._promote_if_needed(ref)
+        assert ref.id.binary() in ctx._inline_promoted
+        put_ref = ray_tpu.put({"tiny": 1})
+        ctx._promote_if_needed(put_ref)
+        assert put_ref.id.binary() not in ctx._inline_promoted
+
+    def test_head_counts_inline_pulls_apart(self, ray_start):
+        """Inline-tagged pulls route to prefetch_issued_inline /
+        prefetch_wasted_inline — the issued/wasted pair behind the
+        doctor waste-ratio check measures only real speculation."""
+        from ray_tpu.core import protocol as P
+        import ray_tpu.core.api as core_api
+        from ray_tpu.core.head import NodeState
+        from ray_tpu.core.ids import ObjectID, _random_bytes
+        from ray_tpu.core.resources import ResourceSet, \
+            detect_node_resources
+
+        head = core_api._head
+        head.enable_tcp(host="127.0.0.1")  # transfer addr for node 0
+        rec = _RecorderConn()
+        fake_idx = 990
+        node = NodeState(idx=fake_idx,
+                         resources=detect_node_resources(num_cpus=1),
+                         store=None, store_name="fake",
+                         agent_conn=rec, node_ip="127.0.0.1")
+        head.nodes[fake_idx] = node
+        oid_i = ObjectID(_random_bytes(ObjectID.SIZE))
+        oid_r = ObjectID(_random_bytes(ObjectID.SIZE))
+        try:
+            for oid in (oid_i, oid_r):
+                head.objects.record_sealed(oid, 0, 4096, "owner")
+            head.leases["L-inline-test"] = (fake_idx, ResourceSet({}),
+                                            "", None, None)
+            base = (head.prefetch_issued, head.prefetch_issued_inline,
+                    head.prefetch_wasted, head.prefetch_wasted_inline)
+            head._h_prefetch_hint(
+                rec, 0, "L-inline-test",
+                [oid_i.binary(), oid_r.binary()], [oid_i.binary()])
+            pulls = [s for s in rec.sent if s[0] == P.PULL_OBJECT]
+            assert len(pulls) == 2, rec.sent
+            assert head.prefetch_issued - base[0] == 1
+            assert head.prefetch_issued_inline - base[1] == 1
+            # teardown: the inline pull's abort is booked apart too
+            head._abort_lease_prefetches("L-inline-test")
+            assert head.prefetch_wasted - base[2] == 1
+            assert head.prefetch_wasted_inline - base[3] == 1
+        finally:
+            head.leases.pop("L-inline-test", None)
+            head.nodes.pop(fake_idx, None)
+
+    def test_batch_frame_mixed_tuple_shapes(self, ray_start):
+        """PREFETCH_HINT_BATCH entries may be r15 2-tuples or r16
+        3-tuples — both decode, neither crashes the head loop."""
+        from ray_tpu.core import protocol as P
+        from ray_tpu.core.context import get_context
+
+        ctx = get_context()
+        ctx.head.send(P.PREFETCH_HINT_BATCH,
+                      [("no-such-lease", [b"q" * 16]),
+                       ("other-lease", [b"r" * 16], [b"r" * 16])])
+        assert ctx.head.call(P.PING, timeout=10)[0] == "pong"
+
+
+# ================================================= chaos (slow tier)
+
+
+def _tiny_jax_stages(n_stages, fwd_sleep_s=0.0, seed=0):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    D = 8
+
+    def fn(p, x):
+        if fwd_sleep_s:
+            time.sleep(fwd_sleep_s)  # paces the vjp trace = forward
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    stages = [
+        pl.PipelineStage(fn=fn, params={
+            "w": jnp.asarray(rng.normal(size=(D, D)).astype(np.float32)),
+            "b": jnp.asarray(rng.normal(size=(D,)).astype(np.float32))})
+        for _ in range(n_stages)]
+
+    def loss_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    mbs = [jnp.asarray(rng.normal(size=(4, D)).astype(np.float32))
+           for _ in range(8)]
+    tgts = [jnp.asarray(rng.normal(size=(4, D)).astype(np.float32))
+            for _ in range(8)]
+    return stages, loss_fn, mbs, tgts
+
+
+def _tree_max_err(a, b):
+    import jax
+
+    return max(float(np.max(np.abs(np.asarray(x) - np.asarray(y))))
+               for x, y in zip(jax.tree_util.tree_leaves(a),
+                               jax.tree_util.tree_leaves(b)))
+
+
+@pytest.mark.slow
+def test_pipeline_node_kill_chaos_real_agents():
+    """kill -9 of a REAL agent node hosting a mid-pipeline stage during
+    a 1F1B batch: the job completes with losses/grads numerically equal
+    to the driver-side oracle and redo bounded by one wave."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2, "num_tpus": 0})
+    handles = []
+    try:
+        handles = [cluster.add_remote_node(num_cpus=2)
+                   for _ in range(2)]
+        stages, loss_fn, mbs, tgts = _tiny_jax_stages(
+            3, fwd_sleep_s=0.25)
+        ref_loss, ref_grads = pl.single_program_reference(
+            stages, loss_fn, mbs, tgts)
+        pipe = pl.Pipeline(stages, loss_fn=loss_fn, schedule="1f1b",
+                           max_inflight_microbatches=4)
+        pipe._refresh_stage_nodes()
+        assert len(set(pipe.stage_nodes)) == 3, pipe.stage_nodes
+        victim_stage = 1
+        victim = pipe.stage_nodes[victim_stage]
+        handle = next(h for h in handles if h.node_idx == victim)
+        out = {}
+
+        def run():
+            out["res"] = pipe.run_batch(mbs, tgts, by_ref_min_bytes=0)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        time.sleep(3.0)  # into the first wave
+        handle.terminate()  # SIGKILL the agent process
+        t.join(timeout=180)
+        assert not t.is_alive(), "repair did not complete"
+        st = pipe.stats()
+        assert st["pipeline_repairs"] >= 1, st
+        assert st["repair_redo_microbatches"] <= 4, st
+        assert abs(out["res"]["loss"] - ref_loss) < 1e-6, \
+            (out["res"]["loss"], ref_loss)
+        grads = pipe.grads()
+        for k in range(len(stages)):
+            assert _tree_max_err(grads[k], ref_grads[k]) < 1e-5, k
+        pipe.shutdown()
+    finally:
+        for h in handles:
+            try:
+                h.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_pipeline_drain_chaos_real_agents():
+    """Graceful drain of a real agent node hosting a live stage
+    mid-run: the stage migrates at a wave boundary BEFORE the
+    shutdown — zero failed tasks, drain_migrated_leases >= 1, grads
+    still equal the oracle, and the drained node's copies were
+    replicated off."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2, "num_tpus": 0})
+    handles = []
+    try:
+        handles = [cluster.add_remote_node(num_cpus=2)
+                   for _ in range(2)]
+        stages, loss_fn, mbs, tgts = _tiny_jax_stages(
+            3, fwd_sleep_s=0.2)
+        ref_loss, ref_grads = pl.single_program_reference(
+            stages, loss_fn, mbs, tgts)
+        pipe = pl.Pipeline(stages, loss_fn=loss_fn, schedule="1f1b",
+                           max_inflight_microbatches=2)
+        pipe._refresh_stage_nodes()
+        victim = pipe.stage_nodes[1]
+        assert victim in {h.node_idx for h in handles}
+        out = {}
+
+        def run():
+            out["res"] = pipe.run_batch(mbs, tgts, by_ref_min_bytes=0)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        time.sleep(2.0)
+        assert ray_tpu.drain_node(victim) is True
+        t.join(timeout=180)
+        assert not t.is_alive(), "drain migration wedged the batch"
+        st = pipe.stats()
+        assert st["stage_migrations"] >= 1, st
+        assert st["pipeline_repairs"] == 0, st
+        assert abs(out["res"]["loss"] - ref_loss) < 1e-6
+        grads = pipe.grads()
+        for k in range(len(stages)):
+            assert _tree_max_err(grads[k], ref_grads[k]) < 1e-5, k
+        # the drain completes gracefully once the batch's leases moved
+        deadline = time.monotonic() + 60
+        rows = True
+        while time.monotonic() < deadline:
+            rows = [r for r in state.list_nodes()
+                    if r["node_idx"] == victim]
+            if not rows:
+                break
+            time.sleep(0.5)
+        assert not rows, rows
+        io = state.io_loop_stats()[0]
+        assert io["drain_migrated_leases"] >= 1, io
+        failed = [r for r in state.list_tasks(limit=2000)
+                  if r["state"] == "FAILED"]
+        assert not failed, failed
+        types = [e["type"] for e in state.list_cluster_events()]
+        assert "node_drained" in types, types
+        pipe.shutdown()
+    finally:
+        for h in handles:
+            try:
+                h.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+        cluster.shutdown()
