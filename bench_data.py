@@ -1,0 +1,405 @@
+"""Pipelined shuffle A/B bench -> BENCH_shuffle_r17.json.
+
+Phases (bench_scale conventions: ``--phases``/``--out``, per-phase
+``loop_lag`` blocks, JSON merge across processes; interleaved A/B pairs,
+median-of-pairwise — this host has multi-x run drift, so only paired
+ratios in one window mean anything). Inter-node links are PACED
+(`RAY_TPU_HOST_EGRESS_LIMIT_BPS` seeds every process's transfer-server
+token bucket) — unpaced loopback moves a 1 MiB part in ~1 ms and hides
+exactly the transfer the exchange exists to overlap.
+
+1. **shuffle** — N-block ``random_shuffle`` on a paced 2-node cluster:
+   the pipelined exchange (streamed split admission with
+   holder-locality, the merge fold tree with eager free, partition
+   homes, columnar split/merge kernels, merge-side prefetch hints) vs
+   the PRE-r17 drain-based executor preserved verbatim behind
+   ``data_shuffle_pipelined=False`` (upstream ref drain, row-path
+   kernels, every part held to its terminal merge) — row-identical
+   output. Gate: pipelined wall <= 0.67x drain (median of pairs).
+
+2. **footprint** — same workload, peak head-directory entries sampled
+   by a background thread, A/B drain vs pipelined (borrow grace shrunk
+   so the sampler sees true liveness, not the ~1s free-deferral tail).
+   Gate: pipelined peak <= 0.7x drain peak — the eager-free
+   O(n_out x (window+fanin)) bound vs the baseline's O(n_in x n_out).
+
+3. **hints** — ``data_shuffle_prefetch_hints`` ON vs OFF (the per-task
+   ``prefetch_args`` opt-out on fold/merge submissions), one fresh
+   cluster per round so the cumulative phase histograms stay separable.
+   Gates: merge-side ``arg_fetch`` p95 improves with hints on, and
+   ``prefetch_issued > 0`` on the ON round.
+
+Run: python bench_data.py [--pairs 3] [--phases shuffle,footprint,hints]
+     [--out BENCH_shuffle_r17.json] [--smoke]
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TPU_CHIPS", "0")
+os.environ.setdefault("RAY_TPU_PRESTART_WORKERS", "0")
+
+# paced inter-node links (bench_pipeline convention)
+LINK_MIB_S = 40
+os.environ.setdefault("RAY_TPU_HOST_EGRESS_LIMIT_BPS",
+                      str(LINK_MIB_S * 1024 * 1024))
+
+N_BLOCKS = 24
+BLOCK_KIB = 1024      # ~1 MiB blocks: parts cross the paced link
+N_OUT = 8             # output partitions: parts are ~128 KiB plasma
+#                       objects (by-ref merge args), not inline values
+READ_S = 0.12         # per-block production cost (IO-paced read
+#                       emulation): the overlap the pipelined exchange
+#                       exists to exploit — splits/folds/transfers run
+#                       UNDER the stream instead of after it
+SMOKE = False
+
+
+def _median(xs):
+    return statistics.median(xs) if xs else 0.0
+
+
+class _LoopLag:
+    """Per-phase head loop-lag capture (bench_scale convention)."""
+
+    def snap(self):
+        from ray_tpu import state
+
+        try:
+            row = state.io_loop_stats()[0]
+        except Exception:  # noqa: BLE001 — no cluster yet
+            row = {}
+        self._before = row
+        return self
+
+    def delta(self) -> dict:
+        from ray_tpu import state
+
+        try:
+            row = state.io_loop_stats()[0]
+        except Exception:  # noqa: BLE001
+            return {}
+        before = getattr(self, "_before", {})
+        return {
+            "loop_lag_ms_p50": row.get("loop_lag_ms_p50", 0.0),
+            "loop_lag_ms_p99": row.get("loop_lag_ms_p99", 0.0),
+            "loop_lag_ms_max": row.get("loop_lag_ms_max", 0.0),
+            "slow_events": row.get("slow_events", 0)
+            - before.get("slow_events", 0),
+            "fold_queue_drops": row.get("fold_queue_drops", 0)
+            - before.get("fold_queue_drops", 0),
+        }
+
+
+def _start_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2, "num_tpus": 0,
+                                      "object_store_memory": 1 << 30})
+    handle = cluster.add_remote_node(num_cpus=2,
+                                     object_store_memory=512 << 20)
+    return cluster, handle
+
+
+def _dataset():
+    import numpy as np
+
+    from ray_tpu import data
+
+    pad = np.zeros(BLOCK_KIB * 1024 // 8, np.uint8)
+
+    def fatten(b):
+        if READ_S:
+            time.sleep(READ_S)
+        n = len(b["id"])
+        return {"id": b["id"], "pad": np.stack([pad] * max(n, 1))[:n]}
+
+    return (data.range(N_BLOCKS * 8, parallelism=N_BLOCKS)
+            .map_batches(fatten))
+
+
+def _set_mode(drain: bool):
+    """drain=True runs the PRE-r17 exchange verbatim
+    (``data_shuffle_pipelined=False``: upstream ref drain, row-path
+    kernels, no admission/locality/folds — the baseline this PR
+    replaced); False runs the pipelined defaults."""
+    from ray_tpu.core.config import get_config
+
+    cfg = get_config()
+    cfg.data_shuffle_pipelined = not drain
+    if not drain:
+        cfg.data_shuffle_inflight_window = 0  # auto
+        cfg.data_shuffle_merge_fanin = 8
+
+
+def _run_shuffle(seed: int):
+    import ray_tpu
+
+    ds = _dataset().random_shuffle(seed=seed,
+                                   num_blocks=N_OUT).materialize()
+    # materialize() returns at SUBMISSION of the terminal merges; the
+    # wall must include execution — wait for every output block
+    refs = ds.to_arrow_refs()
+    ray_tpu.wait(refs, num_returns=len(refs), timeout=600,
+                 fetch_local=False)
+    return ds
+
+
+# ------------------------------------------------------------ shuffle
+
+
+def bench_shuffle(pairs: int) -> dict:
+    cluster, handle = _start_cluster()
+    lag = _LoopLag().snap()
+    dataset_bytes = N_BLOCKS * BLOCK_KIB * 1024
+    rows = []
+    try:
+        # warm: worker spawn + function export + first-touch paths
+        _set_mode(False)
+        _run_shuffle(0)
+        for i in range(pairs):
+            _set_mode(True)
+            t0 = time.perf_counter()
+            _run_shuffle(100 + i)
+            drain_wall = time.perf_counter() - t0
+            _set_mode(False)
+            t0 = time.perf_counter()
+            _run_shuffle(200 + i)
+            pipe_wall = time.perf_counter() - t0
+            rows.append({
+                "drain_wall_s": round(drain_wall, 3),
+                "pipe_wall_s": round(pipe_wall, 3),
+                "ratio": round(pipe_wall / drain_wall, 3),
+                "pipe_mb_s": round(dataset_bytes / pipe_wall / 1e6, 1),
+                "drain_mb_s": round(dataset_bytes / drain_wall / 1e6,
+                                    1),
+            })
+            print(f"  pair {i}: drain {drain_wall:.2f}s "
+                  f"pipe {pipe_wall:.2f}s "
+                  f"ratio {pipe_wall / drain_wall:.3f}",
+                  file=sys.stderr, flush=True)
+        lag_delta = lag.delta()
+    finally:
+        try:
+            handle.terminate()
+        except Exception:  # noqa: BLE001
+            pass
+        cluster.shutdown()
+    ratio = _median([r["ratio"] for r in rows])
+    return {
+        "blocks": N_BLOCKS, "block_mib": BLOCK_KIB / 1024,
+        "n_out": N_OUT, "read_s_per_block": READ_S,
+        "link_mib_s": LINK_MIB_S,
+        "pairs": rows,
+        "wall_ratio_median_of_pairs": ratio,
+        "pipe_mb_s_median": _median([r["pipe_mb_s"] for r in rows]),
+        "gate_ratio_le_0_67": ratio <= 0.67,
+        "loop_lag": lag_delta,
+    }
+
+
+# ---------------------------------------------------------- footprint
+
+
+def _peak_entries(run) -> int:
+    from ray_tpu import state
+
+    peak = [0]
+    stop = threading.Event()
+
+    def sample():
+        while not stop.is_set():
+            try:
+                peak[0] = max(peak[0],
+                              len(state.list_objects(limit=8000)))
+            except Exception:  # noqa: BLE001
+                break
+            time.sleep(0.05)
+
+    t = threading.Thread(target=sample, daemon=True)
+    t.start()
+    run()
+    stop.set()
+    t.join(timeout=5)
+    return peak[0]
+
+
+def bench_footprint(pairs: int) -> dict:
+    from ray_tpu.core.context import get_context
+    from ray_tpu.data import executor as dx
+
+    cluster, handle = _start_cluster()
+    lag = _LoopLag().snap()
+    get_context().ref_counter._grace_s = 0.1
+    rows = []
+    try:
+        _set_mode(False)
+        _run_shuffle(0)  # warm
+        for i in range(pairs):
+            _set_mode(True)
+            drain_peak = _peak_entries(lambda: _run_shuffle(300 + i))
+            time.sleep(1)
+            _set_mode(False)
+            pipe_peak = _peak_entries(lambda: _run_shuffle(400 + i))
+            time.sleep(1)
+            rows.append({"drain_peak": drain_peak,
+                         "pipe_peak": pipe_peak,
+                         "ratio": round(pipe_peak / max(drain_peak, 1),
+                                        3)})
+            print(f"  pair {i}: drain peak {drain_peak} "
+                  f"pipe peak {pipe_peak}", file=sys.stderr,
+                  flush=True)
+        stats = dict(dx.SHUFFLE_STATS)
+        lag_delta = lag.delta()
+    finally:
+        try:
+            handle.terminate()
+        except Exception:  # noqa: BLE001
+            pass
+        cluster.shutdown()
+    ratio = _median([r["ratio"] for r in rows])
+    return {
+        "blocks": N_BLOCKS, "n_out": N_OUT,
+        "pairs": rows,
+        "peak_ratio_median_of_pairs": ratio,
+        "shuffle_stats": stats,
+        "gate_peak_ratio_le_0_7": ratio <= 0.7,
+        "loop_lag": lag_delta,
+    }
+
+
+# -------------------------------------------------------------- hints
+
+
+def _merge_arg_fetch_p95() -> float:
+    from ray_tpu import state
+    from ray_tpu.core.context import get_context
+
+    get_context().events.flush(sync=True)
+    best = 0.0
+    rows = state.phase_summary(["_merge_parts", "_concat_parts"])
+    for func in ("_merge_parts", "_concat_parts"):
+        p = rows.get(func, {}).get("arg_fetch", {})
+        best = max(best, p.get("p95_ms", 0.0))
+    return best
+
+
+def bench_hints(pairs: int) -> dict:
+    import ray_tpu.core.api as core_api
+    from ray_tpu.core.config import get_config
+
+    rounds = {"on": [], "off": []}
+    issued_on = 0
+    for i in range(pairs):
+        for mode in ("off", "on"):
+            cluster, handle = _start_cluster()
+            try:
+                cfg = get_config()
+                cfg.data_shuffle_prefetch_hints = mode == "on"
+                _set_mode(False)
+                _run_shuffle(0)  # warm
+                _run_shuffle(500 + i)
+                p95 = _merge_arg_fetch_p95()
+                rounds[mode].append(p95)
+                if mode == "on":
+                    issued_on += core_api._head.prefetch_issued
+                print(f"  pair {i} hints {mode}: merge arg_fetch "
+                      f"p95 {p95:.1f} ms", file=sys.stderr, flush=True)
+            finally:
+                try:
+                    handle.terminate()
+                except Exception:  # noqa: BLE001
+                    pass
+                cluster.shutdown()
+    on, off = _median(rounds["on"]), _median(rounds["off"])
+    # pairwise ratios (house methodology): each pair's on/off ran
+    # back-to-back in one window, so only their ratio is comparable
+    # across this host's multi-x run drift
+    ratios = [a / b for a, b in zip(rounds["on"], rounds["off"]) if b]
+    ratio = _median(ratios)
+    return {
+        "blocks": N_BLOCKS, "link_mib_s": LINK_MIB_S,
+        "arg_fetch_p95_ms_hints_on_median": on,
+        "arg_fetch_p95_ms_hints_off_median": off,
+        "pairwise_p95_ratio_median": round(ratio, 3),
+        "p95_reduction_pct": round(100 * (1 - ratio), 1),
+        "prefetch_issued_on_rounds": issued_on,
+        "gate_p95_improves": ratio < 1.0,
+        "gate_prefetch_issued_gt_0": issued_on > 0,
+        "rounds": rounds,
+    }
+
+
+def main():
+    global N_BLOCKS, BLOCK_KIB, N_OUT, READ_S, SMOKE
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pairs", type=int, default=3)
+    ap.add_argument("--phases", default="shuffle,footprint,hints",
+                    help="comma list: shuffle,footprint,hints")
+    ap.add_argument("--out", default="BENCH_shuffle_r17.json")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny sizes, 1 pair, no gates enforced — the "
+                         "tier-1 CI smoke")
+    args = ap.parse_args()
+    if args.smoke:
+        SMOKE = True
+        N_BLOCKS, BLOCK_KIB, N_OUT, READ_S = 8, 128, 4, 0.02
+        args.pairs = 1
+        os.environ["RAY_TPU_HOST_EGRESS_LIMIT_BPS"] = "0"
+    phases = {p.strip() for p in args.phases.split(",") if p.strip()}
+
+    result = {
+        "benchmark": "shuffle_r17",
+        "hardware": f"single host, {os.cpu_count()} cpu, real agent "
+                    "processes, per-process egress buckets",
+        "methodology": "interleaved A/B pairs vs the pre-r17 "
+                       "drain-based executor preserved verbatim behind "
+                       "data_shuffle_pipelined=False, "
+                       "median-of-pairwise; paced 2-node link",
+        "smoke": SMOKE,
+    }
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                prior = json.load(f)
+            for k in ("shuffle", "footprint", "hints"):
+                if k in prior:
+                    result[k] = prior[k]
+        except (OSError, ValueError):
+            pass
+
+    def flush():
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+
+    if "shuffle" in phases:
+        print(f"# shuffle: {N_BLOCKS} x {BLOCK_KIB} KiB blocks, "
+              f"pipelined vs drain, {args.pairs} pairs",
+              file=sys.stderr, flush=True)
+        result["shuffle"] = bench_shuffle(args.pairs)
+        print(json.dumps(result["shuffle"]), file=sys.stderr)
+        flush()
+    if "footprint" in phases:
+        print("# footprint: peak store entries A/B", file=sys.stderr,
+              flush=True)
+        result["footprint"] = bench_footprint(args.pairs)
+        print(json.dumps(result["footprint"]), file=sys.stderr)
+        flush()
+    if "hints" in phases:
+        print("# hints: merge arg_fetch p95 on/off", file=sys.stderr,
+              flush=True)
+        result["hints"] = bench_hints(args.pairs)
+        print(json.dumps(result["hints"]), file=sys.stderr)
+        flush()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

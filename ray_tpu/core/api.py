@@ -225,7 +225,8 @@ def kill(actor: "ActorHandle", *, no_restart: bool = True):
 class RemoteFunction:
     def __init__(self, fn, *, num_cpus=None, num_tpus=None, num_returns=1,
                  resources=None, max_retries=None, retry_exceptions=False,
-                 scheduling_strategy=None, name=None, runtime_env=None):
+                 scheduling_strategy=None, name=None, runtime_env=None,
+                 prefetch_args=True):
         from ray_tpu.runtime_env import validate as _validate_env
 
         self._fn = fn
@@ -238,6 +239,9 @@ class RemoteFunction:
         self._name = name or getattr(fn, "__name__", "task")
         self._runtime_env = _validate_env(runtime_env)
         self._uploaded_env = None  # dirs packed/uploaded once, lazily
+        # False opts this task's by-ref args out of dispatch-time
+        # PREFETCH_HINT speculation (r17; the shuffle's hint A/B knob)
+        self._prefetch_args = prefetch_args
         functools.update_wrapper(self, fn)
 
     def _resolved_env(self):
@@ -268,7 +272,8 @@ class RemoteFunction:
             max_retries=self._max_retries,
             retry_exceptions=self._retry_exceptions,
             name=self._name,
-            runtime_env=self._resolved_env())
+            runtime_env=self._resolved_env(),
+            prefetch_args=self._prefetch_args)
         return refs[0] if self._num_returns == 1 else refs
 
     def bind(self, *args, **kwargs):
@@ -284,7 +289,8 @@ class RemoteFunction:
             resources=None, max_retries=self._max_retries,
             retry_exceptions=self._retry_exceptions,
             scheduling_strategy=self._strategy, name=self._name,
-            runtime_env=self._runtime_env)
+            runtime_env=self._runtime_env,
+            prefetch_args=self._prefetch_args)
         merged.update(opts)
         rf = RemoteFunction(self._fn, **{k: v for k, v in merged.items()
                                          if k in inspect.signature(
@@ -446,7 +452,7 @@ def remote(*args, **kwargs):
                                       if k in allowed})
         allowed = ("num_cpus", "num_tpus", "num_returns", "resources",
                    "max_retries", "retry_exceptions", "scheduling_strategy",
-                   "name", "runtime_env")
+                   "name", "runtime_env", "prefetch_args")
         return RemoteFunction(obj, **{k: v for k, v in kwargs.items()
                                       if k in allowed})
 

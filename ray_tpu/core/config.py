@@ -289,6 +289,56 @@ class Config:
     # (missing by-ref init), or scale-ups are queueing behind placement.
     serve_cold_start_p95_warn_s: float = 30.0
 
+    # --- streaming datasets: pipelined shuffle (r17) ---
+    # Master switch for the r17 exchange. True (default) runs
+    # all-to-all ops as the pipelined object-plane exchange: streamed
+    # split admission with holder-locality, the merge fold tree with
+    # eager part free, per-partition home placement, arena-fill
+    # backpressure and merge-side prefetch hints, with COLUMNAR
+    # split/merge kernels for Arrow blocks (routing computed without
+    # materializing row dicts; ~5x kernel speedup measured at 1 MiB
+    # blocks). False restores the pre-r17 drain-based exchange
+    # verbatim (upstream ref drain, row-path kernels, all parts held
+    # to their terminal merge) — the bench baseline and the escape
+    # hatch should a block shape misbehave under the new kernels.
+    data_shuffle_pipelined: bool = True
+    # Split-task admission window of the data layer's all-to-all
+    # exchange (`data/executor.py`): at most this many split tasks may
+    # be submitted-but-incomplete at once, so upstream blocks are
+    # consumed as a stream instead of drained wholesale and the store's
+    # intermediate part footprint stays O(n_out x (window + fanin))
+    # rather than O(n_in x n_out). 0 (default) sizes the window like
+    # the map-stage budget: 2 tasks per cluster CPU, min 4.
+    data_shuffle_inflight_window: int = 0
+    # Arena-fill backpressure high-water fraction: while ANY node's shm
+    # object-store fill (the `node.object_store_used_bytes /
+    # node.object_store_capacity_bytes` telemetry gauges the head
+    # already exports in its node state rows) exceeds this fraction,
+    # the exchange pauses split admission — a shuffle working set
+    # larger than memory degrades to pacing plus the existing spill
+    # path (`object_spilling_threshold`, deliberately above this
+    # default so pacing engages BEFORE spilling) instead of OOMing the
+    # arena. <= 0 disables the gauge check (window-only admission).
+    data_shuffle_store_highwater: float = 0.75
+    # Merge-side fold-tree fan-in: each output partition folds every
+    # this-many incoming split parts into ONE intermediate block
+    # (order-preserving concat; piled-up intermediates fold again), so
+    # part refs are freed at fold-submission time instead of every
+    # (input, output) part surviving to the terminal merge. A TREE, not
+    # an accumulator chain: rows are copied O(log_fanin(n_in)) times
+    # and no fold waits on a chain of predecessors. Higher = fewer
+    # merge tasks but more parts pending per partition (footprint
+    # O(n_out x (fanin + window))); values < 2 are clamped to 2.
+    data_shuffle_merge_fanin: int = 8
+    # Dispatch-time PREFETCH_HINT / PREFETCH_HINT_BATCH for merge-task
+    # args (the per-task `prefetch_args` option): with hints on, the
+    # head starts pulling a merge's n_in part objects to its node while
+    # earlier merges still compute — wide reads overlap compute, with
+    # the r6 striped pulls doing the heavy lifting for multi-holder
+    # parts. False submits shuffle merges with `prefetch_args=False`
+    # (the bench A/B control; demand fetches still work).
+    data_shuffle_prefetch_hints: bool = True
+
     # --- scheduling ---
     # Hybrid scheduling policy: prefer local node until its utilization
     # exceeds this, then spread (reference: scheduler_spread_threshold).

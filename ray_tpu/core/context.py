@@ -851,7 +851,8 @@ class CoreContext:
 
     def submit_task(self, fn, args, kwargs, *, num_returns=1, resources=None,
                     strategy=None, max_retries=None, retry_exceptions=False,
-                    name="", runtime_env=None) -> List[ObjectRef]:
+                    name="", runtime_env=None,
+                    prefetch_args=True) -> List[ObjectRef]:
         cfg = get_config()
         fn_id = self.fn_manager.export(fn)
         task_id = TaskID.for_normal_task(self.job_id)
@@ -867,6 +868,7 @@ class CoreContext:
             retry_exceptions=retry_exceptions,
             owner=self.worker_id,
             runtime_env=runtime_env or self.job_runtime_env,
+            prefetch_args=prefetch_args,
             trace_ctx=task_events.submit_trace_ctx(),
         )
         arg_ids, holder = self._encode_args(spec, args, kwargs)
@@ -1178,8 +1180,9 @@ class CoreContext:
         if not self.head.is_attached():
             return
         ids = list(dict.fromkeys(
-            enc[1] for spec in batch for enc in spec.args
-            if enc[0] == ARG_REF))[:64]
+            enc[1] for spec in batch
+            if getattr(spec, "prefetch_args", True)
+            for enc in spec.args if enc[0] == ARG_REF))[:64]
         if not ids:
             return
         if cfg.prefetch_hint_dedupe_ttl_s > 0:

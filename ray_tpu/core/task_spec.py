@@ -65,6 +65,12 @@ class TaskSpec:
     max_concurrency: int = 1
     # options
     runtime_env: Optional[dict] = None
+    # Dispatch-time speculative prefetch opt-out (r17): False excludes
+    # this task's by-ref args from PREFETCH_HINT frames (grant-time
+    # prefetch and demand fetches are unaffected). The data layer's
+    # shuffle uses it as its hint A/B control
+    # (`data_shuffle_prefetch_hints`).
+    prefetch_args: bool = True
     # caller's active span context, (trace_id, parent_span_id), stamped at
     # submission so the executing worker parents its task span under the
     # submit site (reference: tracing_helper.py injecting the OpenTelemetry

@@ -107,7 +107,11 @@ class _Handler(BaseHTTPRequestHandler):
                       # per-pipeline-stage bubble/transfer/exec view
                       # (r15) — same head data as summary/tasks, keyed
                       # stage{k}.fwd/bwd and split per stage
-                      "pipeline": state.pipeline_stage_summary}.get(kind)
+                      "pipeline": state.pipeline_stage_summary,
+                      # pipelined-exchange counters (r17): cluster
+                      # data.shuffle_* metric rows + the driver-local
+                      # live SHUFFLE_STATS view
+                      "shuffle": state.data_shuffle_summary}.get(kind)
                 if fn is None:
                     self._json({"error": f"unknown summary {kind}"}, 404)
                 else:
@@ -211,7 +215,7 @@ DOCTOR_ENDPOINTS = (
     "/api/io_loop", "/api/object_plane", "/api/cluster_events",
     "/api/metrics", "/api/jobs", "/api/timeline",
     "/api/summary/tasks", "/api/summary/actors", "/api/summary/objects",
-    "/api/summary/pipeline",
+    "/api/summary/pipeline", "/api/summary/shuffle",
     "/api/serve/applications",
     "/metrics",
 )

@@ -65,6 +65,23 @@ class BlockAccessor:
     def block(self) -> Block:
         return self._block
 
+    @property
+    def is_arrow(self) -> bool:
+        return self._is_arrow
+
+    def key_column(self, name) -> Optional[List[Any]]:
+        """Python scalars of one plain (non-tensor) column, or None
+        when the block/column can't serve it columnar. Values are
+        EXACTLY what the row path's ``row[name]`` yields (`to_pylist`
+        python scalars, never numpy scalars) — the exchange's
+        cross-process `_det_hash` routing depends on that."""
+        if not self._is_arrow or not isinstance(name, str):
+            return None
+        if name not in self._block.column_names or \
+                f"__shape__{name}" in self._block.column_names:
+            return None
+        return self._block.column(name).to_pylist()
+
     def num_rows(self) -> int:
         if self._is_arrow:
             return self._block.num_rows

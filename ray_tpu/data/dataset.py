@@ -116,8 +116,16 @@ class Dataset:
     def repartition(self, num_blocks: int) -> "Dataset":
         return self._with_all_to_all("repartition", num_blocks=num_blocks)
 
-    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_blocks: Optional[int] = None) -> "Dataset":
+        """Global random shuffle via the pipelined exchange.
+        ``num_blocks`` sets the output partition count (ref parity:
+        ``Dataset.random_shuffle(num_blocks=...)``); default keeps the
+        input block count. Fewer, larger partitions mean fewer
+        (input x output) exchange parts — worth setting when the input
+        is many small blocks."""
         return self._with_all_to_all("random_shuffle",
+                                     num_blocks=num_blocks,
                                      seed=seed if seed is not None
                                      else int(time.time() * 1000) & 0xffff)
 

@@ -50,6 +50,7 @@ import ray_tpu
 from ray_tpu.core.api import NodeAffinitySchedulingStrategy, \
     PlacementGroupSchedulingStrategy
 from ray_tpu.core.config import get_config
+from ray_tpu.core.task_graph import TaskGraphExecutor, TaskNode
 from ray_tpu.train.pipeline_schedules import SCHEDULES, validate_order
 
 
@@ -558,73 +559,57 @@ class Pipeline:
 
     def _run_wave(self, microbatches, tgts, mb_offset: int,
                   by_ref_min_bytes: int) -> list:
+        """One wave of the schedule, expressed on the shared task-graph
+        executor (``core/task_graph.py``, extracted from this method's
+        r15 inline walk): each stage is a LANE (per-actor seqno order =
+        the stage's local program), F/B dataflow rides by-ref dep edges
+        gated on producer SUBMISSION (the object plane handles data
+        readiness), and every activation/cotangent handle is dropped by
+        the executor the moment its single consumer is submitted —
+        eager free, O(stages) steady-state arena footprint."""
         S, M = self.num_stages, len(microbatches)
         orders = SCHEDULES[self.schedule](S, M)
         validate_order(orders)
-        inputs: List[Any] = [self._maybe_put(x, by_ref_min_bytes)
-                             for x in microbatches]
-        # live refs, popped the moment their single consumer is
-        # submitted (eager activation free: the owner free fires at
-        # consumer completion instead of batch end)
-        F: Dict[tuple, Any] = {}
-        G: Dict[tuple, Any] = {}
-        f_done: set = set()
-        g_done: set = set()
-        b0_refs: Dict[int, Any] = {}  # stage-0 backwards: wave barrier
-        out_refs: List[Any] = [None] * M
-        idx = [0] * S
-        total = sum(len(o) for o in orders)
-        submitted = 0
-        while submitted < total:
-            progressed = False
-            for k in range(S):
-                actor = self.actors[k]
-                while idx[k] < len(orders[k]):
-                    op, mb = orders[k][idx[k]]
-                    if op == "F":
-                        if k == 0:
-                            x = inputs[mb]
-                            inputs[mb] = None  # driver handle dropped
-                        else:
-                            if (k - 1, mb) not in f_done:
-                                break
-                            x = F.pop((k - 1, mb))
-                        kwargs = {}
-                        if k == S - 1 and tgts[mb] is not None:
-                            kwargs["target"] = tgts[mb]
-                        ref = actor.fwd.options(
-                            name=f"{self.name_prefix}stage{k}.fwd"
-                        ).remote(x, mb_offset + mb, **kwargs)
-                        del x
-                        f_done.add((k, mb))
-                        if k == S - 1:
-                            out_refs[mb] = ref
-                        else:
-                            F[(k, mb)] = ref
-                    else:  # "B"
-                        if k == S - 1:
-                            g = None
-                        else:
-                            if (k + 1, mb) not in g_done:
-                                break
-                            g = G.pop((k + 1, mb))
-                        ref = actor.bwd.options(
-                            name=f"{self.name_prefix}stage{k}.bwd"
-                        ).remote(g, mb_offset + mb)
-                        del g
-                        g_done.add((k, mb))
-                        if k == 0:
-                            b0_refs[mb] = ref
-                        else:
-                            G[(k, mb)] = ref
-                    idx[k] += 1
-                    submitted += 1
-                    progressed = True
-            if not progressed:  # pragma: no cover — validate_order gates
-                raise RuntimeError("pipeline submission wedged")
+        g = TaskGraphExecutor()
+        for mb, x in enumerate(microbatches):
+            g.add_value(("in", mb), self._maybe_put(x, by_ref_min_bytes))
+
+        def mk_fwd(actor, k, mb, target):
+            def fwd(x):
+                kwargs = {} if target is None else {"target": target}
+                return actor.fwd.options(
+                    name=f"{self.name_prefix}stage{k}.fwd"
+                ).remote(x, mb_offset + mb, **kwargs)
+
+            return fwd
+
+        def mk_bwd(actor, k, mb):
+            def bwd(*grads):  # () for the last stage: it seeds g=None
+                return actor.bwd.options(
+                    name=f"{self.name_prefix}stage{k}.bwd"
+                ).remote(grads[0] if grads else None, mb_offset + mb)
+
+            return bwd
+
+        for k in range(S):
+            actor = self.actors[k]
+            for op, mb in orders[k]:
+                if op == "F":
+                    deps = [("in", mb)] if k == 0 else [("F", k - 1, mb)]
+                    tgt = tgts[mb] if k == S - 1 else None
+                    g.add(TaskNode(("F", k, mb),
+                                   mk_fwd(actor, k, mb, tgt), deps,
+                                   lane=k, keep=k == S - 1))
+                else:  # "B"
+                    deps = [] if k == S - 1 else [("B", k + 1, mb)]
+                    g.add(TaskNode(("B", k, mb), mk_bwd(actor, k, mb),
+                                   deps, lane=k, keep=k == 0))
+        kept = g.run()
+        out_refs = [kept[("F", S - 1, mb)] for mb in range(M)]
         # barrier: the wave is done when every microbatch's stage-0
         # backward (the tail of its dependency chain) has completed
-        ray_tpu.get(list(b0_refs.values()), timeout=600)
+        ray_tpu.get([kept[("B", 0, mb)] for mb in range(M)],
+                    timeout=600)
         return out_refs
 
     @staticmethod
