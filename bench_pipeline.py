@@ -1,6 +1,8 @@
-"""MPMD pipeline A/B bench -> BENCH_pipeline_r15.json.
+"""MPMD pipeline A/B bench -> BENCH_pipeline_r15.json (+ r16 repair
+phases -> BENCH_repair_r16.json, r18 DP/collective phases ->
+BENCH_dp_r18.json).
 
-Two phases (bench_scale conventions: ``--phases``/``--out``, per-phase
+Phases (bench_scale conventions: ``--phases``/``--out``, per-phase
 ``loop_lag`` blocks, JSON merge across processes so phases can run as
 separate processes; interleaved A/B pairs, median-of-pairwise — this
 host has multi-x run drift, so only paired ratios in one window mean
@@ -40,9 +42,23 @@ the pipeline exists to overlap.
    ``drain_migrated_leases`` >= 1, grads equal the oracle, and the
    drained node's object copies remain fetchable from survivors.
 
+5. **collective** (r18, -> ``--dp-out`` BENCH_dp_r18.json) — ring vs
+   rendezvous allreduce at 64 MiB x 4 ranks, one rank per paced agent
+   node. Gates: ring effective bandwidth >= 2x the rendezvous
+   baseline (median-of-pairs), and ZERO collective payload bytes
+   through the driver (head relay-bytes + head-host transfer-server
+   counters flat across the ring rounds).
+
+6. **dp** (r18, same artifact) — the PP x DP composition:
+   3-stage x 12-microbatch 1F1B at replicas_per_stage = 2 vs 1.
+   Gates: wall ratio <= 0.65 (ideal (M/2+S-1)/(M+S-1) ~ 0.57 at this
+   shape), grads within 1e-5 of the driver-side oracle, replica pairs
+   bit-identical after the batch-end bucketed grad all-reduce.
+
 Run: python bench_pipeline.py [--pairs 3]
-     [--phases schedule,hints,chaos,drain]
+     [--phases schedule,hints,chaos,drain,dp,collective]
      [--out BENCH_pipeline_r15.json] [--repair-out BENCH_repair_r16.json]
+     [--dp-out BENCH_dp_r18.json]
 """
 
 import argparse
@@ -178,14 +194,14 @@ def _mk_hetero_stages(tfs, tb):
             for k in range(n)]
 
 
-def _start_cluster(n_remote):
+def _start_cluster(n_remote, store_bytes=512 << 20):
     from ray_tpu.cluster_utils import Cluster
 
     cluster = Cluster(initialize_head=True,
                       head_node_args={"num_cpus": 1, "num_tpus": 0,
                                       "object_store_memory": 1 << 30})
     handles = [cluster.add_remote_node(num_cpus=1,
-                                       object_store_memory=512 << 20)
+                                       object_store_memory=store_bytes)
                for _ in range(n_remote)]
     return cluster, handles
 
@@ -396,14 +412,19 @@ CKPT_D = 192  # param dim: 192x192 f32 weights (~147 KiB) keep stage
 #               object plane and the off-node replication path is real
 
 
-def _mk_ckpt_jax_stages(n_stages, fwd_sleep_s, seed=0):
-    """jax-mode stages big enough that snapshots are plasma-resident;
-    forward paced with a sleep (executes during the vjp trace)."""
+def _mk_ckpt_jax_stages(n_stages, fwd_sleep_s, seed=0, dim=None,
+                        micro=None):
+    """jax-mode stages big enough that snapshots are plasma-resident
+    (at the default ``dim=CKPT_D``); forward paced with a sleep
+    (executes during the vjp trace). The r18 DP phase shrinks ``dim``
+    (more workers, sleep-dominated walls) and widens ``micro``."""
     import jax.numpy as jnp
     import numpy as np
 
     from ray_tpu.train.pipeline import PipelineStage
 
+    dim = CKPT_D if dim is None else dim
+    micro = MICRO if micro is None else micro
     rng = np.random.default_rng(seed)
 
     def fn(p, x):
@@ -414,21 +435,21 @@ def _mk_ckpt_jax_stages(n_stages, fwd_sleep_s, seed=0):
     stages = [
         PipelineStage(fn=fn, params={
             "w": jnp.asarray(
-                rng.normal(size=(CKPT_D, CKPT_D)).astype(np.float32)
+                rng.normal(size=(dim, dim)).astype(np.float32)
                 * 0.05),
             "b": jnp.asarray(
-                rng.normal(size=(CKPT_D,)).astype(np.float32))})
+                rng.normal(size=(dim,)).astype(np.float32))})
         for _ in range(n_stages)]
 
     def loss_fn(y, t):
         return jnp.mean((y - t) ** 2)
 
     mbs = [jnp.asarray(
-        rng.normal(size=(4, CKPT_D)).astype(np.float32))
-        for _ in range(MICRO)]
+        rng.normal(size=(4, dim)).astype(np.float32))
+        for _ in range(micro)]
     tgts = [jnp.asarray(
-        rng.normal(size=(4, CKPT_D)).astype(np.float32))
-        for _ in range(MICRO)]
+        rng.normal(size=(4, dim)).astype(np.float32))
+        for _ in range(micro)]
     return stages, loss_fn, mbs, tgts
 
 
@@ -658,14 +679,318 @@ def bench_drain() -> dict:
     }
 
 
+# --------------------------------------- DP x collective (r18)
+
+
+class _CollMember:
+    """Bench rank actor: builds its payload locally (the driver never
+    ships tensor bytes) and times the allreduce in-process."""
+
+    def __init__(self, rank: int):
+        self.rank = rank
+
+    def init_collective(self, world_size, rank, group_name):
+        from ray_tpu import collective
+
+        collective.init_collective_group(world_size, rank,
+                                         group_name=group_name)
+        return True
+
+    def node(self):
+        from ray_tpu.core.context import get_context
+
+        return get_context().node_idx
+
+    def timed_allreduce(self, group_name, n, transport):
+        import numpy as np
+
+        from ray_tpu import collective
+
+        x = np.full(n, self.rank + 1.0, np.float32)
+        t0 = time.perf_counter()
+        out = collective.allreduce(x, group_name=group_name,
+                                   transport=transport, timeout=300)
+        wall = time.perf_counter() - t0
+        return {"wall_s": wall, "first": float(out[0]),
+                "last": float(out[-1])}
+
+
+COLL_RANKS = 4
+COLL_MIB = 64
+
+
+def bench_collective(pairs: int) -> dict:
+    """Ring vs rendezvous allreduce A/B: 64 MiB x 4 ranks, one rank
+    actor per paced agent node. The gate baseline is the RENDEZVOUS
+    FUNNEL (transport="rendezvous": every rank ships its full payload
+    to the coordinator — the O(R·S)-through-one-node path ROADMAP item
+    4 names); the r5 slice-exchange (transport="object") is measured
+    alongside for honesty, since it already spreads bytes across
+    stores and the ring's win over it is pipelining, not topology.
+    Gates: ring effective bandwidth >= 2x the rendezvous baseline
+    (median of interleaved pairs), results numerically identical, and
+    ZERO collective payload bytes through the driver — counter-
+    asserted on the head's relay-bytes and the head-host transfer
+    server across the ring rounds (the driver's own wire egress is
+    reported too; it carries only control frames)."""
+    import ray_tpu
+    import ray_tpu.core.api as core_api
+    from ray_tpu import collective, state
+    from ray_tpu.core import protocol as P
+    from ray_tpu.core.api import NodeAffinitySchedulingStrategy
+
+    n = COLL_MIB * (1 << 20) // 4  # fp32 elements
+    payload_bytes = n * 4
+    # 1 GiB agent arenas: the FUNNEL baseline parks R full-size result
+    # objects on the coordinator's node per op, and grace-deferred
+    # frees from the previous round may still be draining
+    cluster, handles = _start_cluster(COLL_RANKS,
+                                      store_bytes=1 << 30)
+    head = core_api._head
+    lag = _LoopLag().snap()
+    g = "bench_coll"
+    expected = (sum(r + 1.0 for r in range(COLL_RANKS)), )
+    try:
+        cls = ray_tpu.remote(_CollMember)
+        members = [cls.options(
+            num_cpus=1,
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                h.node_idx, soft=False)).remote(r)
+            for r, h in enumerate(handles)]
+        collective.create_collective_group(
+            members, COLL_RANKS, list(range(COLL_RANKS)), group_name=g)
+        # warm: spawn + imports + first-touch of every code path, at
+        # full size ONCE so pair 0 doesn't pay cold mmap/arena growth
+        for t in ("rendezvous", "object", "ring"):
+            ray_tpu.get([m.timed_allreduce.remote(g, 1 << 18, t)
+                         for m in members], timeout=300)
+        ray_tpu.get([m.timed_allreduce.remote(g, n, "ring")
+                     for m in members], timeout=600)
+
+        def one_round(transport):
+            t0 = time.perf_counter()
+            rows = ray_tpu.get(
+                [m.timed_allreduce.remote(g, n, transport)
+                 for m in members], timeout=600)
+            driver_wall = time.perf_counter() - t0
+            for row in rows:
+                assert row["first"] == row["last"] == expected[0], row
+            wall = max(r["wall_s"] for r in rows)
+            # settle OUTSIDE the timed window: grace-deferred frees of
+            # the round's objects drain before the next round's puts
+            # contend for arena space
+            time.sleep(2.0)
+            return {"wall_s": round(wall, 3),
+                    "driver_wall_s": round(driver_wall, 3),
+                    "bw_mib_s": round(COLL_MIB / wall, 2)}
+
+        rows = []
+        ring_wire = relay_delta = served_delta = 0
+        for i in range(pairs):
+            rdv = one_round("rendezvous")
+            exch = one_round("object")
+            # driver-byte counters window the RING rounds only: the
+            # funnel baseline legitimately parks its result objects on
+            # whatever node hosts the coordinator (possibly the head's)
+            # — that is its measured pathology, not the ring's
+            w0 = P.WIRE.snapshot().get("bytes_sent", 0)
+            relay0 = head.relay_bytes
+            served0 = (head._transfer_server.bytes_served
+                       if head._transfer_server else 0)
+            ring = one_round("ring")
+            ring_wire += P.WIRE.snapshot().get("bytes_sent", 0) - w0
+            relay_delta += head.relay_bytes - relay0
+            served_delta += (head._transfer_server.bytes_served
+                             if head._transfer_server else 0) - served0
+            rows.append({
+                "rendezvous": rdv, "exchange": exch, "ring": ring,
+                "bw_ratio": round(ring["bw_mib_s"] / rdv["bw_mib_s"],
+                                  3),
+                "bw_ratio_vs_exchange": round(
+                    ring["bw_mib_s"] / exch["bw_mib_s"], 3)})
+            print(f"  pair {i}: rdv {rdv['wall_s']}s "
+                  f"({rdv['bw_mib_s']} MiB/s) exch {exch['wall_s']}s "
+                  f"ring {ring['wall_s']}s "
+                  f"({ring['bw_mib_s']} MiB/s) ratio "
+                  f"{rows[-1]['bw_ratio']}", file=sys.stderr,
+                  flush=True)
+        coll_row = state.object_plane_stats().get("collective", {})
+        lag_delta = lag.delta()
+        for m in members:
+            try:
+                ray_tpu.kill(m)
+            except Exception:  # noqa: BLE001
+                pass
+        collective.destroy_collective_group(g)
+    finally:
+        for h in handles:
+            try:
+                h.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+        cluster.shutdown()
+    ratio = _median([r["bw_ratio"] for r in rows])
+    ring_payload = pairs * COLL_RANKS * 2 * payload_bytes
+    return {
+        "ranks": COLL_RANKS, "payload_mib": COLL_MIB,
+        "link_mib_s": LINK_MIB_S,
+        "pairs": rows,
+        "bw_mib_s_median": {
+            "rendezvous": _median([r["rendezvous"]["bw_mib_s"]
+                                   for r in rows]),
+            "exchange": _median([r["exchange"]["bw_mib_s"]
+                                 for r in rows]),
+            "ring": _median([r["ring"]["bw_mib_s"] for r in rows])},
+        "bw_ratio_median_of_pairs": ratio,
+        "bw_ratio_vs_exchange_median": _median(
+            [r["bw_ratio_vs_exchange"] for r in rows]),
+        # driver-byte accounting across the RING rounds: payload moves
+        # store-to-store between agent arenas, so the head-memory relay
+        # path and the head host's transfer server must both stay flat;
+        # the driver's socket egress is control-only (task submission,
+        # state queries) and is reported against the ~payload volume
+        "driver_relay_bytes_delta": relay_delta,
+        "head_server_bytes_delta": served_delta,
+        "driver_wire_mib_during_ring": round(ring_wire / 2**20, 3),
+        "ring_payload_mib_total": round(ring_payload / 2**20, 1),
+        "collective_counters": coll_row,
+        "gate_bw_ratio_ge_2x": ratio >= 2.0,
+        # "zero payload bytes": the head-memory relay stays EXACTLY
+        # flat, and the head-host server / driver socket deltas stay
+        # under ONE payload chunk (control frames — ref exchanges,
+        # task submission — are KBs; a single smuggled payload chunk
+        # would be >= collective_ring_chunk_bytes)
+        "gate_zero_driver_payload_bytes": bool(
+            relay_delta == 0 and served_delta < (1 << 20)
+            and ring_wire < 8 * (1 << 20)),
+        "loop_lag": lag_delta,
+    }
+
+
+DP_STAGES = 3
+DP_MICRO = 12
+DP_FWD_SLEEP = 0.35
+DP_DIM = 64
+
+
+def bench_dp(pairs: int) -> dict:
+    """PP x DP composition A/B: the SAME 3-stage jax pipeline (sleep-
+    paced forwards) at replicas_per_stage=1 vs 2, both runs over the
+    full 12-microbatch batch. 2 replicas halve each stage's microbatch
+    depth — 1F1B wall (M/R + S - 1)/(M + S - 1) ~ 0.57x ideal — while
+    the batch-end bucketed grad all-reduce (overlapped with the tail
+    backward waves) must keep grads EQUAL to the 1-replica oracle.
+    Gates: wall ratio <= 0.65, grad max err < 1e-5 vs the driver-side
+    oracle, replica pairs bit-identical after the sync."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import state
+    from ray_tpu.train.pipeline import Pipeline, \
+        single_program_reference
+
+    # 6 agents x 2 cpus: the 1-replica gang (3 actors) and the DP gang
+    # (6 actors) stay alive together for interleaved pairs; compute is
+    # sleep-paced so co-hosted actors don't contend
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1, "num_tpus": 0,
+                                      "object_store_memory": 1 << 30})
+    handles = [cluster.add_remote_node(num_cpus=2,
+                                       object_store_memory=256 << 20)
+               for _ in range(6)]
+    lag = _LoopLag().snap()
+    try:
+        stages, loss_fn, mbs, tgts = _mk_ckpt_jax_stages(
+            DP_STAGES, fwd_sleep_s=DP_FWD_SLEEP, seed=7, dim=DP_DIM,
+            micro=DP_MICRO)
+        ref_loss, ref_grads = single_program_reference(
+            stages, loss_fn, mbs, tgts)
+        pipe1 = Pipeline(stages, loss_fn=loss_fn, schedule="1f1b",
+                         name_prefix="dp1_")
+        pipe2 = Pipeline(stages, loss_fn=loss_fn, schedule="1f1b",
+                         replicas_per_stage=2, name_prefix="dp2_")
+        # warm: spawn + jax imports + first compiles on every worker
+        pipe1.run_batch(mbs[:2], tgts[:2], by_ref_min_bytes=0)
+        pipe2.run_batch(mbs[:4], tgts[:4], by_ref_min_bytes=0)
+        rows = []
+        for i in range(pairs):
+            pipe1.reset()
+            t0 = time.perf_counter()
+            out1 = pipe1.run_batch(mbs, tgts, by_ref_min_bytes=0)
+            wall1 = time.perf_counter() - t0
+            pipe2.reset()
+            t0 = time.perf_counter()
+            out2 = pipe2.run_batch(mbs, tgts, by_ref_min_bytes=0)
+            wall2 = time.perf_counter() - t0
+            rows.append({"wall_1rep_s": round(wall1, 3),
+                         "wall_2rep_s": round(wall2, 3),
+                         "ratio": round(wall2 / wall1, 3)})
+            print(f"  pair {i}: 1rep {wall1:.2f}s 2rep {wall2:.2f}s "
+                  f"ratio {wall2 / wall1:.3f}", file=sys.stderr,
+                  flush=True)
+        # numerics from the LAST pair's DP run
+        loss_err = abs(out2["loss"] - ref_loss)
+        grads2 = pipe2.grads()
+        grad_err = max(_tree_max_err(grads2[k], ref_grads[k])
+                       for k in range(DP_STAGES))
+        loss1_err = abs(out1["loss"] - ref_loss)
+        # replica pairs hold identical grads after the sync
+        sync_err = 0.0
+        for k in range(DP_STAGES):
+            g0, g1 = ray_tpu.get(
+                [pipe2.actors[2 * k].grads.remote(True),
+                 pipe2.actors[2 * k + 1].grads.remote(True)],
+                timeout=120)
+            sync_err = max(sync_err, _tree_max_err(g0, g1))
+        st2 = pipe2.stats()
+        coll_row = state.object_plane_stats().get("collective", {})
+        lag_delta = lag.delta()
+        pipe1.shutdown()
+        pipe2.shutdown()
+    finally:
+        for h in handles:
+            try:
+                h.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+        cluster.shutdown()
+    ratio = _median([r["ratio"] for r in rows])
+    M, S = DP_MICRO, DP_STAGES
+    ideal = (M // 2 + S - 1) / (M + S - 1)
+    return {
+        "stages": S, "replicas": 2, "microbatches": M,
+        "fwd_sleep_s": DP_FWD_SLEEP, "param_dim": DP_DIM,
+        "link_mib_s": LINK_MIB_S,
+        "pairs": rows,
+        "wall_ratio_median_of_pairs": ratio,
+        "ideal_ratio_no_overhead": round(ideal, 3),
+        "loss_err_1rep": loss1_err,
+        "loss_err_2rep": loss_err,
+        "grad_max_err_vs_oracle": grad_err,
+        "replica_sync_max_err": sync_err,
+        "grad_allreduces": st2["grad_allreduces"],
+        "collective_counters": coll_row,
+        "gate_wall_ratio_le_0_65": ratio <= 0.65,
+        "gate_grads_equal_oracle": bool(grad_err < 1e-5
+                                        and loss_err < 1e-6),
+        "gate_replicas_synced": sync_err == 0.0,
+        "loop_lag": lag_delta,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--pairs", type=int, default=3)
     ap.add_argument("--phases", default="schedule,hints",
-                    help="comma list: schedule,hints,chaos,drain")
+                    help="comma list: schedule,hints,chaos,drain,"
+                         "dp,collective")
     ap.add_argument("--out", default="BENCH_pipeline_r15.json")
     ap.add_argument("--repair-out", default="BENCH_repair_r16.json",
                     help="artifact for the chaos/drain (r16) phases")
+    ap.add_argument("--dp-out", default="BENCH_dp_r18.json",
+                    help="artifact for the dp/collective (r18) phases")
     args = ap.parse_args()
     phases = {p.strip() for p in args.phases.split(",") if p.strip()}
 
@@ -717,6 +1042,33 @@ def main():
         with open(args.repair_out, "w") as f:
             json.dump(repair, f, indent=1)
 
+    # r18 DP/collective phases merge into their own artifact
+    dp = {
+        "benchmark": "dp_collective_r18",
+        "hardware": f"single host, {os.cpu_count()} cpu, "
+                    "real agent processes, per-process egress buckets",
+        "methodology": "interleaved A/B pairs, median-of-pairwise "
+                       "(MICROBENCH_r6); paced inter-node links; "
+                       "collective = ring vs rendezvous transports on "
+                       "one group, driver-byte counters asserted "
+                       "across the ring rounds; dp = replicas_per_"
+                       "stage 2 vs 1 on the same batch, grads vs the "
+                       "driver-side oracle",
+    }
+    if os.path.exists(args.dp_out):
+        try:
+            with open(args.dp_out) as f:
+                prior = json.load(f)
+            for k in ("dp", "collective"):
+                if k in prior:
+                    dp[k] = prior[k]
+        except (OSError, ValueError):
+            pass
+
+    def flush_dp():
+        with open(args.dp_out, "w") as f:
+            json.dump(dp, f, indent=1)
+
     if "schedule" in phases:
         print(f"# schedule: {STAGES}-stage x {MICRO}-microbatch 1F1B "
               f"vs sequential, {args.pairs} pairs",
@@ -742,10 +1094,26 @@ def main():
         repair["drain"] = bench_drain()
         print(json.dumps(repair["drain"]), file=sys.stderr)
         flush_repair()
+    if "collective" in phases:
+        print(f"# collective: ring vs rendezvous, {COLL_MIB} MiB x "
+              f"{COLL_RANKS} ranks, {args.pairs} pairs",
+              file=sys.stderr, flush=True)
+        dp["collective"] = bench_collective(args.pairs)
+        print(json.dumps(dp["collective"]), file=sys.stderr)
+        flush_dp()
+    if "dp" in phases:
+        print(f"# dp: {DP_STAGES} stages x 2 replicas vs 1, "
+              f"{DP_MICRO} microbatches, {args.pairs} pairs",
+              file=sys.stderr, flush=True)
+        dp["dp"] = bench_dp(args.pairs)
+        print(json.dumps(dp["dp"]), file=sys.stderr)
+        flush_dp()
     if "chaos" in phases or "drain" in phases:
         print(json.dumps(repair))
     if "schedule" in phases or "hints" in phases:
         print(json.dumps(result))
+    if "dp" in phases or "collective" in phases:
+        print(json.dumps(dp))
 
 
 if __name__ == "__main__":

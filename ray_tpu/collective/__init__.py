@@ -3,11 +3,16 @@
 TPU-first split of the reference's ray.util.collective (SURVEY.md §2.3):
 tensor-plane collectives are XLA programs (jax.lax.psum et al. over ICI —
 see ray_tpu.parallel); this module covers the host plane the reference
-used NCCL/Gloo groups for: gang barriers, broadcasts, small-array
-allreduce/allgather between actors, via a per-group rendezvous actor.
+used NCCL/Gloo groups for: gang barriers, broadcasts, gradient
+allreduce/reduce_scatter/allgather between data-parallel actors. The
+coordination plane is a per-group rendezvous actor; the data plane (r18)
+is the object plane — chunked ring / halving-doubling tree collectives
+moving bytes store-to-store — with the pre-r18 rendezvous transport
+preserved behind ``collective_transport="rendezvous"``.
 """
 
 from .collective import (
+    CollectiveError,
     Rendezvous,
     allgather,
     allreduce,
@@ -20,11 +25,12 @@ from .collective import (
     init_collective_group,
     is_group_initialized,
     reduce,
+    reduce_scatter,
 )
 
 __all__ = [
     "init_collective_group", "destroy_collective_group", "allreduce",
-    "allgather", "broadcast", "barrier", "reduce", "get_rank",
-    "get_collective_group_size", "is_group_initialized",
-    "create_collective_group", "Rendezvous",
+    "reduce_scatter", "allgather", "broadcast", "barrier", "reduce",
+    "get_rank", "get_collective_group_size", "is_group_initialized",
+    "create_collective_group", "Rendezvous", "CollectiveError",
 ]

@@ -198,6 +198,32 @@ class Config:
     # many microbatches.
     pipeline_max_inflight_microbatches: int = 0
 
+    # --- data-parallel pipelines (r18) ---
+    # Default replica count per pipeline stage for ``train.Pipeline``
+    # (the constructor's ``replicas_per_stage=`` overrides). With R > 1
+    # the pipeline becomes the MPMD paper's full PP x DP composition:
+    # each stage runs as R gang-placed actors, microbatch mb flows
+    # through replica (mb mod R) of EVERY stage (activations never
+    # cross replicas — R independent 1-wide pipelines share the stage
+    # program), and at batch end each stage's replica group runs a
+    # bucketed gradient all-reduce over ``ray_tpu.collective`` (ring
+    # transport by default), submitted into each replica's task lane
+    # right after its last backward so late stages' grad sync overlaps
+    # early stages' remaining backward waves. Grads after run_batch
+    # equal the 1-replica run (sum of per-replica sums, mean over the
+    # global microbatch count).
+    pipeline_replicas_per_stage: int = 1
+    # Bucket size for the batch-end data-parallel grad all-reduce:
+    # consecutive same-dtype gradient leaves are concatenated into
+    # ~this-many-byte flat buckets and each bucket is all-reduced
+    # separately, so the first buckets' ring hops overlap the later
+    # buckets' (and other stages') work and no single collective
+    # payload grows with model size. Mirrors the reference DDP /
+    # NCCL-group bucketing. Must be identical across replicas (it is,
+    # via shared config — the bucket split must line up for the ring's
+    # chunk exchange to rendezvous).
+    pipeline_grad_bucket_bytes: int = 16 * 1024 * 1024
+
     # --- elastic pipeline repair (r16) ---
     # Object-plane stage checkpoints: every this-many completed WAVES
     # (see ``pipeline_max_inflight_microbatches`` — with bound 0 the
@@ -232,6 +258,38 @@ class Config:
     # ``doctor_warnings()`` flags a node stuck draining past this
     # deadline (the escalation itself wedged).
     drain_deadline_s: float = 30.0
+
+    # --- host-plane collectives (r18) ---
+    # Default transport family for ray_tpu.collective operations when a
+    # call passes transport="auto". "ring" (default): the data plane is
+    # the OBJECT PLANE — each rank put()s its chunks into its local
+    # arena and peers pull them store-to-store (striped pulls, r13
+    # typed zero-copy reducer; neither the coordinator actor nor the
+    # driver ever carries payload bytes, counter-asserted in
+    # BENCH_dp_r18.json), with sized payloads riding a chunked ring
+    # reduce-scatter+allgather (~2·(R-1)/R·nbytes per rank, per-hop
+    # pulls warmed ahead of the fold) and small payloads a
+    # halving-doubling tree (log2 R hops) on power-of-two worlds.
+    # "rendezvous": the pre-r18 auto behavior, preserved verbatim —
+    # payloads below 256 KiB funnel inline through the per-group
+    # rendezvous actor (whose incremental fold keeps its peak memory at
+    # O(1) payloads), larger ones ride the two-round slice exchange.
+    # Per-call transport= overrides (transport="rendezvous" forces the
+    # pure coordinator funnel — the only data plane with ZERO
+    # object-plane involvement, the true escape hatch and the bench's
+    # A/B baseline); every rank of one operation must resolve the SAME
+    # family (identical config + shapes do).
+    collective_transport: str = "ring"
+    # Chunk size for the ring/tree collectives' object-plane payloads:
+    # each published slice is split into ~this-many-byte arena objects,
+    # so a consumer's pull of chunk k+1 (started ahead by the
+    # OBJECT_WARM prefetch) overlaps its fold of chunk k, and per-pull
+    # latency stays bounded on paced links. Smaller chunks = more
+    # overlap but more per-object control traffic (put + directory +
+    # pull round-trips); the default suits multi-MiB gradient buckets.
+    # Must agree across the ranks of one operation (same config, or the
+    # same explicit chunk_bytes= argument).
+    collective_ring_chunk_bytes: int = 4 * 1024 * 1024
 
     # --- serve at scale (r14) ---
     # How long a ``slow_node`` detector flag stays routable-around: the

@@ -4021,6 +4021,13 @@ class Head:
             "prefetch_issued_inline": self.prefetch_issued_inline,
             "prefetch_completed_inline": self.prefetch_completed_inline,
             "prefetch_wasted_inline": self.prefetch_wasted_inline,
+            # r18 host-plane collectives: the cluster-merged
+            # collective.* metric rows summarized (ops / bytes by
+            # algorithm + hop p95) — the ring's payload bytes move
+            # store-to-store, so they show up HERE and in the agents'
+            # serve counters, never in relay_bytes or the head
+            # server's bytes_served
+            "collective": self._collective_summary_locked(),
             # the head host's own transfer server, split by
             # source role (root = sealed copy, relay = re-served
             # in-progress partial); agent-side servers report
@@ -4037,6 +4044,44 @@ class Head:
                     self._transfer_server.relay_bytes_served,
             } if self._transfer_server is not None else {}),
         }]
+
+    def _collective_summary_locked(self):
+        """Aggregate the merged ``collective.*`` metric rows into the
+        object_plane snapshot (r18): per-algorithm tag slices sum into
+        ops / bytes_sent / bytes_recv totals plus a per-algorithm
+        breakdown, and the merged hop histogram yields hop_p95_s.
+        Takes the metrics lock itself (called from _sq_object_plane,
+        which holds no locks)."""
+        out = {"ops": 0.0, "bytes_sent": 0.0, "bytes_recv": 0.0,
+               "hop_p95_s": 0.0, "by_algorithm": {}}
+        hop = None
+        hop_bounds = None
+        with self._metrics_lock:
+            rows = [dict(r) for (name, _), r in self.metrics.items()
+                    if name.startswith("collective.")]
+        for row in rows:
+            short = row["name"][len("collective."):]
+            alg = row["tags"].get("algorithm", "")
+            if row["kind"] == "histogram":
+                if short == "hop_s":
+                    v = row["value"]
+                    if hop is None:
+                        hop = list(v)
+                        hop_bounds = row["boundaries"]
+                    else:
+                        hop = [a + b for a, b in zip(hop, v)]
+                continue
+            if short in ("ops", "bytes_sent", "bytes_recv"):
+                out[short] += row["value"]
+                if alg:
+                    slot = out["by_algorithm"].setdefault(
+                        alg, {"ops": 0.0, "bytes_sent": 0.0,
+                              "bytes_recv": 0.0})
+                    slot[short] += row["value"]
+        if hop and hop_bounds:
+            out["hop_p95_s"] = round(
+                _hist_quantile(hop_bounds, hop, 0.95), 6)
+        return out
 
     def _sq_metrics(self, limit):
         # merged client metrics plus the head's own ring-buffer

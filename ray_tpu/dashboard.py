@@ -106,7 +106,10 @@ class _Handler(BaseHTTPRequestHandler):
                       "objects": state.summarize_objects,
                       # per-pipeline-stage bubble/transfer/exec view
                       # (r15) — same head data as summary/tasks, keyed
-                      # stage{k}.fwd/bwd and split per stage
+                      # stage{k}.fwd/bwd and split per stage; DP runs
+                      # (r18, stage{k}r{rep}.*) add a "replicas"
+                      # sub-dict per stage so stragglers attribute per
+                      # (stage, replica)
                       "pipeline": state.pipeline_stage_summary,
                       # pipelined-exchange counters (r17): cluster
                       # data.shuffle_* metric rows + the driver-local

@@ -187,12 +187,20 @@ def pipeline_stage_summary(prefix: Optional[str] = None
     unprefixed). Default ``None`` matches any prefix; when several
     pipelines ran under different prefixes, each (stage, op) slot keeps
     the variant with the most completed ops (pass ``prefix=`` to
-    disambiguate an A/B explicitly)."""
+    disambiguate an A/B explicitly).
+
+    Data-parallel pipelines (r18) submit ops as
+    ``{prefix}stage{k}r{rep}.fwd``; those rows land under the stage's
+    ``"replicas"`` sub-dict — ``{rep: {"fwd": ..., "bwd": ...,
+    "bubble_ms_p95", "transfer_ms_p95", "exec_ms_p95"}}`` — so a DP
+    straggler attributes per (stage, replica), while the stage-level
+    p95s aggregate over replicas (max: the gang waits for its slowest
+    member)."""
     import re
 
     rows = phase_summary()
     stages: Dict[int, Dict[str, Any]] = {}
-    pat = re.compile(r"^(.*?)stage(\d+)\.(fwd|bwd)$")
+    pat = re.compile(r"^(.*?)stage(\d+)(?:r(\d+))?\.(fwd|bwd)$")
 
     def _n(phases):
         return phases.get("exec", {}).get("count", 0)
@@ -201,19 +209,35 @@ def pipeline_stage_summary(prefix: Optional[str] = None
         m = pat.match(func)
         if not m:
             continue
-        pfx, k, op = m.group(1), int(m.group(2)), m.group(3)
+        pfx, k, rep, op = (m.group(1), int(m.group(2)), m.group(3),
+                           m.group(4))
         if prefix is not None and pfx != prefix:
             continue
         slot = stages.setdefault(k, {})
+        if rep is not None:
+            slot = slot.setdefault("replicas", {}).setdefault(
+                int(rep), {})
         if op not in slot or _n(phases) > _n(slot[op]):
             slot[op] = phases
+    metrics = (("bubble_ms_p95", "sched_wait"),
+               ("transfer_ms_p95", "arg_fetch"),
+               ("exec_ms_p95", "exec"))
+
+    def _agg(slot):
+        for metric, phase in metrics:
+            slot[metric] = max(
+                (slot[op].get(phase, {}).get("p95_ms", 0.0)
+                 for op in ("fwd", "bwd") if op in slot),
+                default=0.0)
+
     for k, d in stages.items():
-        for metric, phase in (("bubble_ms_p95", "sched_wait"),
-                              ("transfer_ms_p95", "arg_fetch"),
-                              ("exec_ms_p95", "exec")):
-            d[metric] = max((d[op].get(phase, {}).get("p95_ms", 0.0)
-                             for op in ("fwd", "bwd") if op in d),
-                            default=0.0)
+        reps = d.get("replicas", {})
+        for rd in reps.values():
+            _agg(rd)
+        _agg(d)
+        for metric, _ in metrics:
+            d[metric] = max([d[metric]] + [rd[metric]
+                                           for rd in reps.values()])
     return stages
 
 

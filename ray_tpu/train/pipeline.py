@@ -34,6 +34,16 @@ plain task graph over those actors:
   exec (compute), and a deliberately slow stage trips the existing
   straggler detector under its own name.
 
+- **data-parallel replicas** (r18, the MPMD paper's full PP x DP
+  composition): ``replicas_per_stage=R`` runs R gang-placed actors per
+  stage, routes microbatch mb through replica (mb mod R) of every
+  stage — R independent 1-wide pipelines sharing the stage programs,
+  zero cross-replica traffic during the schedule — and syncs grads at
+  batch end with a bucketed all-reduce per stage's replica group over
+  ``ray_tpu.collective``'s object-plane ring, submitted into each
+  replica's lane right after its last backward so late stages' sync
+  overlaps early stages' remaining backward waves.
+
 The SPMD cousin ``parallel/pipeline.py`` pipelines inside one XLA
 program over the ``pipeline`` mesh axis; this module is the
 multi-program face for stages too big or too heterogeneous to live in
@@ -51,7 +61,8 @@ from ray_tpu.core.api import NodeAffinitySchedulingStrategy, \
     PlacementGroupSchedulingStrategy
 from ray_tpu.core.config import get_config
 from ray_tpu.core.task_graph import TaskGraphExecutor, TaskNode
-from ray_tpu.train.pipeline_schedules import SCHEDULES, validate_order
+from ray_tpu.train.pipeline_schedules import SCHEDULES, \
+    replica_orders, validate_order, validate_replica_orders
 
 
 @dataclass
@@ -84,20 +95,34 @@ class PipelineStage:
 
 
 class _StageWorker:
-    """Actor hosting one stage: params + per-microbatch saved contexts
-    + accumulated grads. Stateless across batches once ``reset()``."""
+    """Actor hosting one stage replica: params + per-microbatch saved
+    contexts + accumulated grads. Stateless across batches once
+    ``reset()``. With data-parallel replicas (r18) each replica of a
+    stage runs one of these, sees only its microbatch subset, and syncs
+    grads with its siblings via ``allreduce_grads`` at batch end."""
 
     def __init__(self, stage_idx: int, num_stages: int,
-                 stage: PipelineStage, loss_fn=None):
+                 stage: PipelineStage, loss_fn=None, replica: int = 0):
         self.k = stage_idx
         self.S = num_stages
+        self.replica = replica
         self._stage = stage
         self._loss_fn = loss_fn
         self._ctx: Dict[int, Any] = {}
+        #: LOCAL grads accumulated since the last reset()/grad sync
         self._gsum = None
         self._nmb = 0
+        #: already-SYNCED global grads from prior allreduce_grads
+        #: rounds (None/0 until a sync ran). Kept separate from the
+        #: local accumulator so a second run_batch without reset()
+        #: cannot re-contribute batch 1's global sum R times to batch
+        #: 2's all-reduce — totals are base + local, exactly the R=1
+        #: cross-batch accumulation semantics.
+        self._gsum_base = None
+        self._nmb_base = 0
         self._delay_fwd_s = 0.0
         self._delay_only_mb: Optional[int] = None
+        self._dp_group: Optional[str] = None
 
     # -------------------------------------------------- chaos / tests
 
@@ -111,13 +136,102 @@ class _StageWorker:
     def probe(self) -> dict:
         from ray_tpu.core.context import get_context as _gc
 
-        return {"stage": self.k, "node_idx": _gc().node_idx,
+        return {"stage": self.k, "replica": self.replica,
+                "node_idx": _gc().node_idx,
                 "live_contexts": len(self._ctx)}
+
+    # -------------------------------------- data-parallel sync (r18)
+
+    def init_collective(self, world_size: int, rank: int,
+                        group_name: str):
+        """Join this replica to its stage's collective group (driver
+        gang-creates one group per stage via
+        ``collective.create_collective_group``)."""
+        from ray_tpu import collective
+
+        collective.init_collective_group(world_size, rank,
+                                         group_name=group_name)
+        self._dp_group = group_name
+        return True
+
+    def allreduce_grads(self, bucket_bytes: int,
+                        transport: str = "auto",
+                        timeout: float = 300.0) -> int:
+        """Batch-end data-parallel gradient sync: sum the LOCAL grads
+        (and microbatch counts) accumulated since the last sync across
+        this stage's replica group, bucketed — consecutive same-dtype
+        leaves concatenate into ~bucket_bytes flat payloads, each
+        all-reduced separately so the first buckets' ring hops overlap
+        the later buckets'. Submitted into each replica's task lane
+        right after its last backward, so late stages sync while early
+        stages still run backward waves. The synced global sum folds
+        into ``_gsum_base`` and the local accumulator resets — every
+        replica then holds identical totals, and a later un-reset
+        run_batch contributes only its OWN new grads (matching R=1
+        cross-batch accumulation). Returns the cumulative global
+        microbatch count."""
+        import numpy as np
+
+        from ray_tpu import collective
+
+        if self._dp_group is None:
+            raise RuntimeError(
+                "stage replica has no collective group; "
+                "allreduce_grads requires replicas_per_stage > 1")
+        # one inline round carries [my microbatch count, has-grads]:
+        # the group must agree on whether the bucket rounds happen, and
+        # a replica that saw zero microbatches (M < R edge) or a
+        # grad-less raw stage must not desync siblings that did
+        local = self._gsum
+        rows = collective.allgather(
+            np.asarray([float(self._nmb),
+                        1.0 if local is not None else 0.0]),
+            group_name=self._dp_group, transport="inline",
+            timeout=timeout)
+        delta_nmb = int(round(sum(float(r[0]) for r in rows)))
+        if local is None and any(float(r[1]) > 0 for r in rows):
+            if self._stage.params is None:
+                raise RuntimeError(
+                    "replica gradient sets diverge (some replicas hold "
+                    "grads, this one has none and no params to zero-"
+                    "fill) — give every replica at least one "
+                    "microbatch")
+            import jax
+
+            local = jax.tree_util.tree_map(
+                lambda p: np.zeros_like(np.asarray(p)),
+                self._stage.params)
+        if local is not None:
+            import jax
+
+            leaves, treedef = jax.tree_util.tree_flatten(local)
+            arrs = [np.asarray(leaf) for leaf in leaves]
+            for idxs in _grad_buckets(arrs, bucket_bytes):
+                flat = (arrs[idxs[0]].reshape(-1) if len(idxs) == 1
+                        else np.concatenate(
+                            [arrs[i].reshape(-1) for i in idxs]))
+                red = np.asarray(collective.allreduce(
+                    flat, group_name=self._dp_group, op="sum",
+                    transport=transport, timeout=timeout))
+                off = 0
+                for i in idxs:
+                    n = arrs[i].size
+                    arrs[i] = red[off:off + n].reshape(arrs[i].shape)
+                    off += n
+            synced = jax.tree_util.tree_unflatten(treedef, arrs)
+            self._gsum_base = (synced if self._gsum_base is None
+                               else _tree_add(self._gsum_base, synced))
+        self._gsum = None
+        self._nmb = 0
+        self._nmb_base += delta_nmb
+        return self._nmb_base
 
     def reset(self):
         self._ctx.clear()
         self._gsum = None
         self._nmb = 0
+        self._gsum_base = None
+        self._nmb_base = 0
         return True
 
     # ------------------------------------------- elastic repair (r16)
@@ -130,7 +244,9 @@ class _StageWorker:
         pipeline is drained there — no live per-microbatch contexts to
         capture)."""
         return {"stage": self.k, "params": self._stage.params,
-                "gsum": self._gsum, "nmb": self._nmb}
+                "gsum": self._gsum, "nmb": self._nmb,
+                "gsum_base": self._gsum_base,
+                "nmb_base": self._nmb_base}
 
     def restore(self, snap: dict):
         """Roll this stage back to a snapshot's wave boundary. On a
@@ -143,6 +259,8 @@ class _StageWorker:
         self._stage.params = snap["params"]
         self._gsum = snap["gsum"]
         self._nmb = snap["nmb"]
+        self._gsum_base = snap.get("gsum_base")
+        self._nmb_base = snap.get("nmb_base", 0)
         self._ctx.clear()
         return True
 
@@ -193,21 +311,50 @@ class _StageWorker:
     def grads(self, mean: bool = True):
         """Accumulated parameter cotangents (mean over microbatches by
         default — matches a full-batch mean loss when microbatches are
-        equal-sized and the per-microbatch loss is itself a mean)."""
-        if self._gsum is None or not self._nmb:
+        equal-sized and the per-microbatch loss is itself a mean).
+        Totals combine the synced base (DP runs) with any local grads
+        accumulated since (R=1 runs never sync, so base stays empty)."""
+        if self._gsum_base is None:
+            total, n = self._gsum, self._nmb
+        elif self._gsum is None:
+            total, n = self._gsum_base, self._nmb_base
+        else:
+            total = _tree_add(self._gsum_base, self._gsum)
+            n = self._nmb_base + self._nmb
+        if total is None or not n:
             return None
         if not mean:
-            return self._gsum
+            return total
         import jax
 
-        n = self._nmb
-        return jax.tree_util.tree_map(lambda a: a / n, self._gsum)
+        return jax.tree_util.tree_map(lambda a: a / n, total)
 
 
 def _tree_add(a, b):
     import jax
 
     return jax.tree_util.tree_map(lambda x, y: x + y, a, b)
+
+
+def _grad_buckets(arrs: List[Any], bucket_bytes: int) -> List[List[int]]:
+    """Group consecutive same-dtype gradient leaves into ~bucket_bytes
+    buckets (indices into ``arrs``). Deterministic in the tree order,
+    so every replica computes the identical split — a requirement for
+    the bucket all-reduces to rendezvous."""
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    cur_dt = None
+    for i, a in enumerate(arrs):
+        if cur and (a.dtype != cur_dt or cur_bytes >= bucket_bytes):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += a.nbytes
+        cur_dt = a.dtype
+    if cur:
+        buckets.append(cur)
+    return buckets
 
 
 def _uniform_mode(stages: Sequence[PipelineStage]) -> bool:
@@ -303,10 +450,23 @@ class Pipeline:
     """Driver handle: builds the stage gang, runs schedules.
 
     ``placement`` (default: config ``pipeline_stage_placement``):
-    ``"auto"`` pins stage k to alive node (k mod n) with soft node
-    affinity — one stage per node when the cluster has at least as many
-    nodes as stages; ``"spread"`` uses a SPREAD placement group;
-    ``"none"`` leaves it to the default policy."""
+    ``"auto"`` pins gang member f to alive node (f mod n) with soft
+    node affinity — one actor per node when the cluster has enough
+    nodes; ``"spread"`` uses a SPREAD placement group; ``"none"``
+    leaves it to the default policy.
+
+    ``replicas_per_stage`` (r18, default: config
+    ``pipeline_replicas_per_stage``) composes PP with data parallelism:
+    R gang-placed actors per stage, microbatch mb routed through
+    replica (mb mod R) of every stage (activations never cross
+    replicas), and a batch-end bucketed grad all-reduce per stage's
+    replica group (``ray_tpu.collective`` ring transport riding the
+    object plane) submitted into each replica's lane right after its
+    last backward — late stages sync while early stages still run
+    backward waves. ``self.actors`` is the FLAT gang,
+    ``actors[k * R + rep]``; checkpoints, repair and drain migration
+    treat each (stage, replica) member independently, exactly like a
+    1-wide stage."""
 
     def __init__(self, stages: Sequence[PipelineStage], *,
                  loss_fn: Optional[Callable] = None,
@@ -315,7 +475,10 @@ class Pipeline:
                  num_cpus_per_stage: int = 1,
                  max_inflight_microbatches: Optional[int] = None,
                  pg_timeout_s: float = 60.0,
-                 name_prefix: str = ""):
+                 name_prefix: str = "",
+                 replicas_per_stage: Optional[int] = None,
+                 grad_bucket_bytes: Optional[int] = None,
+                 grad_allreduce_transport: str = "auto"):
         #: prepended to the per-stage task names (``stage{k}.fwd`` ->
         #: ``{prefix}stage{k}.fwd``); mutable between batches — A/B
         #: benches retag rounds so the cumulative phase histograms
@@ -335,6 +498,23 @@ class Pipeline:
                        else max_inflight_microbatches)
         self._num_cpus_per_stage = num_cpus_per_stage
         self._pg = None
+        # ---- data-parallel replicas (r18) ----
+        self._replicas = (cfg.pipeline_replicas_per_stage
+                          if replicas_per_stage is None
+                          else int(replicas_per_stage))
+        if self._replicas < 1:
+            raise ValueError(
+                f"replicas_per_stage must be >= 1, got {self._replicas}")
+        self._grad_bucket_bytes = (cfg.pipeline_grad_bucket_bytes
+                                   if grad_bucket_bytes is None
+                                   else int(grad_bucket_bytes))
+        self._grad_transport = grad_allreduce_transport
+        #: collective group name per stage (empty when R == 1); rebuilt
+        #: with a fresh generation after any actor replacement
+        self._group_names: List[str] = []
+        self._group_gen = 0
+        #: completed batch-end grad all-reduce rounds
+        self.grad_allreduces = 0
         # ---- elastic repair state (r16) ----
         # latest per-stage checkpoint refs + the wave boundary they
         # capture (-1 = batch start); exactly ONE generation is held —
@@ -360,18 +540,78 @@ class Pipeline:
             placement or cfg.pipeline_stage_placement,
             num_cpus_per_stage, pg_timeout_s)
         self._actor_cls = ray_tpu.remote(_StageWorker)
-        self.actors = [self._spawn_stage(k, strategies[k])
-                       for k in range(self.num_stages)]
+        self.actors = [self._spawn_stage(f, strategies[f])
+                       for f in range(self.gang_size)]
+        if self._replicas > 1:
+            self._init_collective_groups()
         self._subscribe_drain_events()
 
-    def _spawn_stage(self, k: int, strategy=None):
-        """Create stage k's actor (construction and repair share it)."""
+    @property
+    def gang_size(self) -> int:
+        """Flat actor count: stages x replicas."""
+        return self.num_stages * self._replicas
+
+    def _stage_of(self, f: int):
+        """Flat gang index -> (stage, replica)."""
+        return divmod(f, self._replicas)
+
+    def _fname(self, f: int, op: str) -> str:
+        """Observability func name for gang member f's op: the r15
+        ``{prefix}stage{k}.{op}`` shape when 1-wide, and
+        ``{prefix}stage{k}r{rep}.{op}`` with replicas so phase
+        histograms / ``pipeline_stage_summary`` attribute DP stragglers
+        per (stage, replica)."""
+        k, rep = self._stage_of(f)
+        base = f"stage{k}" if self._replicas == 1 else f"stage{k}r{rep}"
+        return f"{self.name_prefix}{base}.{op}"
+
+    def _spawn_stage(self, f: int, strategy=None):
+        """Create gang member f's actor (construction and repair share
+        it). ``f`` is the FLAT index ``stage * R + replica``."""
+        k, rep = self._stage_of(f)
         opts: Dict[str, Any] = {"num_cpus": self._num_cpus_per_stage}
         if strategy is not None:
             opts["scheduling_strategy"] = strategy
         return self._actor_cls.options(**opts).remote(
             k, self.num_stages, self._stages[k],
-            self._loss_fn if k == self.num_stages - 1 else None)
+            self._loss_fn if k == self.num_stages - 1 else None,
+            rep)
+
+    # ------------------------------------- replica collectives (r18)
+
+    def _init_collective_groups(self):
+        """One rendezvous group per stage's replica gang, created
+        declaratively on the actors. Regrouped under a FRESH name after
+        any actor replacement (repair / drain migration): a replaced
+        actor's process restarts its per-group sequence numbering, so
+        rejoining the old group would rendezvous rounds out of step —
+        a fresh coordinator generation starts everyone at zero."""
+        import uuid
+
+        from ray_tpu.collective import create_collective_group
+
+        self._destroy_collective_groups()
+        self._group_gen += 1
+        uid = f"{uuid.uuid4().hex[:8]}g{self._group_gen}"
+        R = self._replicas
+        names = []
+        for k in range(self.num_stages):
+            gname = f"_pp{uid}_s{k}"
+            create_collective_group(
+                [self.actors[k * R + j] for j in range(R)], R,
+                list(range(R)), group_name=gname)
+            names.append(gname)
+        self._group_names = names
+
+    def _destroy_collective_groups(self):
+        from ray_tpu.collective import destroy_collective_group
+
+        for g in self._group_names:
+            try:
+                destroy_collective_group(g)  # driver: kills coordinator
+            except Exception:  # noqa: BLE001 — already dead
+                pass
+        self._group_names = []
 
     def _subscribe_drain_events(self):
         """Track head drain announcements so wave boundaries can
@@ -426,31 +666,33 @@ class Pipeline:
 
     def _resolve_placement(self, mode: str, num_cpus: int,
                            pg_timeout_s: float) -> list:
-        S = self.num_stages
+        G = self.gang_size
         if mode == "auto":
             # draining nodes are departing — never pin a fresh stage
             # onto one (r16)
             alive = sorted(n["node_idx"] for n in ray_tpu.nodes()
                            if n.get("alive") and not n.get("draining"))
             if len(alive) <= 1:
-                return [None] * S
-            # soft pinning: a stage whose node fills up may still land
-            # elsewhere rather than wedging the gang
+                return [None] * G
+            # soft pinning: a member whose node fills up may still land
+            # elsewhere rather than wedging the gang. Flat round-robin
+            # also spreads a stage's REPLICAS over distinct nodes when
+            # the cluster allows (consecutive flat indices).
             return [NodeAffinitySchedulingStrategy(
-                alive[k % len(alive)], soft=True) for k in range(S)]
+                alive[f % len(alive)], soft=True) for f in range(G)]
         if mode == "spread":
             self._pg = ray_tpu.placement_group(
-                [{"CPU": num_cpus}] * S, strategy="SPREAD")
+                [{"CPU": num_cpus}] * G, strategy="SPREAD")
             if not self._pg.ready(timeout=pg_timeout_s):
                 raise TimeoutError(
-                    f"SPREAD placement group for {S} stages not ready "
-                    f"after {pg_timeout_s}s")
-            return [PlacementGroupSchedulingStrategy(self._pg, k)
-                    for k in range(S)]
+                    f"SPREAD placement group for {G} gang members not "
+                    f"ready after {pg_timeout_s}s")
+            return [PlacementGroupSchedulingStrategy(self._pg, f)
+                    for f in range(G)]
         if mode != "none":
             raise ValueError(
                 f"unknown placement {mode!r} (have auto/spread/none)")
-        return [None] * S
+        return [None] * G
 
     # ------------------------------------------------------ execution
 
@@ -515,7 +757,8 @@ class Pipeline:
             off, mbs_w, tgts_w = waves[wi]
             try:
                 refs = self._run_wave(mbs_w, tgts_w, off,
-                                      by_ref_min_bytes)
+                                      by_ref_min_bytes,
+                                      final=wi == len(waves) - 1)
             except Exception as err:  # noqa: BLE001 — repair filter below
                 if not elastic:
                     raise
@@ -558,58 +801,104 @@ class Pipeline:
         return result
 
     def _run_wave(self, microbatches, tgts, mb_offset: int,
-                  by_ref_min_bytes: int) -> list:
+                  by_ref_min_bytes: int, final: bool = False) -> list:
         """One wave of the schedule, expressed on the shared task-graph
         executor (``core/task_graph.py``, extracted from this method's
-        r15 inline walk): each stage is a LANE (per-actor seqno order =
-        the stage's local program), F/B dataflow rides by-ref dep edges
-        gated on producer SUBMISSION (the object plane handles data
-        readiness), and every activation/cotangent handle is dropped by
-        the executor the moment its single consumer is submitted —
-        eager free, O(stages) steady-state arena footprint."""
-        S, M = self.num_stages, len(microbatches)
-        orders = SCHEDULES[self.schedule](S, M)
-        validate_order(orders)
+        r15 inline walk): each (stage, replica) is a LANE (per-actor
+        seqno order = the member's local program), F/B dataflow rides
+        by-ref dep edges gated on producer SUBMISSION (the object plane
+        handles data readiness), and every activation/cotangent handle
+        is dropped by the executor the moment its single consumer is
+        submitted — eager free, O(stages) steady-state arena footprint.
+
+        With replicas (r18) microbatch mb belongs to replica
+        ``(mb_offset + mb) % R`` of every stage, so node keys stay
+        ``("F"|"B", stage, mb)`` and all dep edges are replica-local;
+        on the FINAL wave each lane additionally gets an ``("AR", k,
+        rep)`` grad all-reduce node after its last backward — stage
+        S-1's replicas start syncing while stage 0 still drains
+        backward waves (the overlap the bucketed collective exists
+        for)."""
+        S, M, R = self.num_stages, len(microbatches), self._replicas
+        if R == 1:
+            base = SCHEDULES[self.schedule](S, M)
+            validate_order(base)
+            orders = [[base[k]] for k in range(S)]
+        else:
+            rep_of = [(mb_offset + i) % R for i in range(M)]
+            ids_by_rep = [[i for i in range(M) if rep_of[i] == rep]
+                          for rep in range(R)]
+            orders = replica_orders(SCHEDULES[self.schedule], S,
+                                    ids_by_rep)
+            validate_replica_orders(orders)
         g = TaskGraphExecutor()
         for mb, x in enumerate(microbatches):
             g.add_value(("in", mb), self._maybe_put(x, by_ref_min_bytes))
 
-        def mk_fwd(actor, k, mb, target):
+        def mk_fwd(actor, name, k, mb, target):
             def fwd(x):
                 kwargs = {} if target is None else {"target": target}
-                return actor.fwd.options(
-                    name=f"{self.name_prefix}stage{k}.fwd"
-                ).remote(x, mb_offset + mb, **kwargs)
+                return actor.fwd.options(name=name).remote(
+                    x, mb_offset + mb, **kwargs)
 
             return fwd
 
-        def mk_bwd(actor, k, mb):
+        def mk_bwd(actor, name, mb):
             def bwd(*grads):  # () for the last stage: it seeds g=None
-                return actor.bwd.options(
-                    name=f"{self.name_prefix}stage{k}.bwd"
-                ).remote(grads[0] if grads else None, mb_offset + mb)
+                return actor.bwd.options(name=name).remote(
+                    grads[0] if grads else None, mb_offset + mb)
 
             return bwd
 
+        def mk_ar(actor, name):
+            def ar():
+                return actor.allreduce_grads.options(name=name).remote(
+                    self._grad_bucket_bytes, self._grad_transport)
+
+            return ar
+
+        ar_keys = []
         for k in range(S):
-            actor = self.actors[k]
-            for op, mb in orders[k]:
-                if op == "F":
-                    deps = [("in", mb)] if k == 0 else [("F", k - 1, mb)]
-                    tgt = tgts[mb] if k == S - 1 else None
-                    g.add(TaskNode(("F", k, mb),
-                                   mk_fwd(actor, k, mb, tgt), deps,
-                                   lane=k, keep=k == S - 1))
-                else:  # "B"
-                    deps = [] if k == S - 1 else [("B", k + 1, mb)]
-                    g.add(TaskNode(("B", k, mb), mk_bwd(actor, k, mb),
-                                   deps, lane=k, keep=k == 0))
+            for rep in range(len(orders[k])):
+                f = k * R + rep
+                actor = self.actors[f]
+                for op, mb in orders[k][rep]:
+                    if op == "F":
+                        deps = [("in", mb)] if k == 0 \
+                            else [("F", k - 1, mb)]
+                        tgt = tgts[mb] if k == S - 1 else None
+                        g.add(TaskNode(
+                            ("F", k, mb),
+                            mk_fwd(actor, self._fname(f, "fwd"), k, mb,
+                                   tgt),
+                            deps, lane=f, keep=k == S - 1))
+                    else:  # "B"
+                        deps = [] if k == S - 1 else [("B", k + 1, mb)]
+                        g.add(TaskNode(
+                            ("B", k, mb),
+                            mk_bwd(actor, self._fname(f, "bwd"), mb),
+                            deps, lane=f, keep=k == 0))
+                if final and R > 1:
+                    # lane order sequences the sync behind this
+                    # replica's last backward; no cross-lane deps — the
+                    # collective itself rendezvouses the replica group
+                    key = ("AR", k, rep)
+                    g.add(TaskNode(
+                        key, mk_ar(actor, self._fname(f, "allreduce")),
+                        deps=[], lane=f, keep=True))
+                    ar_keys.append(key)
         kept = g.run()
         out_refs = [kept[("F", S - 1, mb)] for mb in range(M)]
         # barrier: the wave is done when every microbatch's stage-0
         # backward (the tail of its dependency chain) has completed
         ray_tpu.get([kept[("B", 0, mb)] for mb in range(M)],
                     timeout=600)
+        if ar_keys:
+            # grad-sync errors surface here; completion also means
+            # every replica holds identical (global-sum) grads before
+            # run_batch returns
+            ray_tpu.get([kept[key] for key in ar_keys], timeout=600)
+            self.grad_allreduces += 1
         return out_refs
 
     @staticmethod
@@ -638,8 +927,8 @@ class Pipeline:
         from ray_tpu.core.context import get_context
 
         refs = [a.snapshot.options(
-            name=f"{self.name_prefix}stage{k}.ckpt").remote()
-            for k, a in enumerate(self.actors)]
+            name=self._fname(f, "ckpt")).remote()
+            for f, a in enumerate(self.actors)]
         ready, rest = ray_tpu.wait(refs, num_returns=len(refs),
                                    timeout=300)
         ctx = get_context()
@@ -725,6 +1014,7 @@ class Pipeline:
         enforced by the caller's retry loop and consumed only when a
         repair COMPLETES (a repair interrupted by a further death
         re-enters with its budget intact)."""
+        from ray_tpu.collective import CollectiveError
         from ray_tpu.core.api import NodeAffinitySchedulingStrategy
         from ray_tpu.core.events import emit_cluster_event
         from ray_tpu.core.exceptions import (
@@ -734,10 +1024,13 @@ class Pipeline:
         # only death-shaped failures are worth the detection poll — an
         # ordinary error (user bug in a stage fn surfacing as a task
         # error) gets ONE immediate check and re-raises promptly
-        # instead of stalling 10s on every legitimate failure
+        # instead of stalling 10s on every legitimate failure.
+        # CollectiveError counts: a replica group's grad sync failing
+        # mid-ring is exactly what a sibling's node death looks like
+        # from the surviving ranks.
         deathlike = isinstance(err, (
             ActorDiedError, ActorUnavailableError, WorkerCrashedError,
-            ObjectLostError, GetTimeoutError))
+            ObjectLostError, GetTimeoutError, CollectiveError))
         dead = self._dead_stages(wait_s=10.0 if deathlike else 0.0)
         if not dead:
             return None
@@ -758,13 +1051,18 @@ class Pipeline:
         # restore an implicit quiescence barrier behind the wave's
         # already-submitted tasks
         restores = []
-        for k, a in enumerate(self.actors):
-            name = f"{self.name_prefix}stage{k}.restore"
-            ck = self._ckpt.get(k)
+        for f, a in enumerate(self.actors):
+            name = self._fname(f, "restore")
+            ck = self._ckpt.get(f)
             restores.append(
                 a.reset.options(name=name).remote() if ck is None
                 else a.restore.options(name=name).remote(ck))
         ray_tpu.get(restores, timeout=300)
+        if self._replicas > 1:
+            # replacement actors restart their collective sequence
+            # numbering — rebuild every stage's replica group under a
+            # fresh coordinator generation before any grad sync runs
+            self._init_collective_groups()
         self._refresh_stage_nodes()
         redo = plan["redo_microbatches"]
         # budget and counters move only on a COMPLETED repair — an
@@ -780,6 +1078,9 @@ class Pipeline:
             extra={"stages": sorted(dead),
                    "placement": {str(k): v for k, v in
                                  plan["placement"].items()},
+                   # flat gang indices; stage = idx // R, replica =
+                   # idx % R (identity when R == 1)
+                   "replicas_per_stage": self._replicas,
                    "restore_wave": plan["restore_wave"],
                    "redo_microbatches": redo,
                    "cause": repr(err)[:200]})
@@ -815,20 +1116,21 @@ class Pipeline:
             return 0  # nowhere to go: the head's deadline decides
         plan = plan_repair(victims, self.stage_nodes, alive, 0, -1, [])
         moved = 0
-        for k in victims:
-            target = plan["placement"][k]
-            name = f"{self.name_prefix}stage{k}"
-            old = self.actors[k]
+        for f in victims:
+            target = plan["placement"][f]
+            old = self.actors[f]
             # mid-batch grads ride the snapshot; the wave boundary
             # guarantees no live contexts
-            snap = old.snapshot.options(name=f"{name}.ckpt").remote()
+            snap = old.snapshot.options(
+                name=self._fname(f, "ckpt")).remote()
             new = self._spawn_stage(
-                k, NodeAffinitySchedulingStrategy(target, soft=True))
+                f, NodeAffinitySchedulingStrategy(target, soft=True))
             ray_tpu.wait([snap], num_returns=1, timeout=300)
             ray_tpu.warm_object(snap, node_idx=target)
             try:
                 ray_tpu.get([new.restore.options(
-                    name=f"{name}.restore").remote(snap)], timeout=300)
+                    name=self._fname(f, "restore")).remote(snap)],
+                    timeout=300)
             except Exception:  # noqa: BLE001 — replacement failed:
                 # keep the old actor (the crash path repairs if the
                 # drain escalates to a kill) and retire the orphaned
@@ -839,20 +1141,23 @@ class Pipeline:
                 except Exception:  # noqa: BLE001
                     pass
                 continue
-            self.actors[k] = new
+            self.actors[f] = new
             try:
                 ray_tpu.kill(old)
             except Exception:  # noqa: BLE001
                 pass
             moved += 1
             self.stage_migrations += 1
+            k, rep = self._stage_of(f)
             emit_cluster_event(
                 "INFO", "pipeline", "pipeline_stage_migrated",
-                f"stage {k} migrated off draining node "
-                f"{(self.stage_nodes or [None] * (k + 1))[k]} "
+                f"stage {k} replica {rep} migrated off draining node "
+                f"{(self.stage_nodes or [None] * (f + 1))[f]} "
                 f"to node {target}",
-                extra={"stage": k, "to_node": target})
+                extra={"stage": k, "replica": rep, "to_node": target})
         if moved:
+            if self._replicas > 1:
+                self._init_collective_groups()
             self._refresh_stage_nodes()
         return moved
 
@@ -862,9 +1167,9 @@ class Pipeline:
         — their last-known entry is kept for the planner's host load
         accounting of SURVIVORS only."""
         skip = skip or set()
-        nodes = list(self.stage_nodes or [-1] * self.num_stages)
+        nodes = list(self.stage_nodes or [-1] * self.gang_size)
         probes = {k: self.actors[k].probe.remote()
-                  for k in range(self.num_stages) if k not in skip}
+                  for k in range(self.gang_size) if k not in skip}
         for k, ref in probes.items():
             try:
                 nodes[k] = ray_tpu.get([ref], timeout=120)[0]["node_idx"]
@@ -882,14 +1187,20 @@ class Pipeline:
             "stage_migrations": self.stage_migrations,
             "checkpoint_wave": self._ckpt_wave,
             "checkpointed_stages": len(self._ckpt),
+            "replicas_per_stage": self._replicas,
+            "grad_allreduces": self.grad_allreduces,
         }
 
     # ---------------------------------------------------- gang state
 
     def grads(self, mean: bool = True) -> list:
-        """Per-stage accumulated parameter grads (driver-fetched)."""
-        return ray_tpu.get([a.grads.remote(mean) for a in self.actors],
-                           timeout=600)
+        """Per-stage accumulated parameter grads (driver-fetched), one
+        entry per STAGE. With replicas the batch-end all-reduce left
+        every replica holding the identical global grads, so replica
+        0's view is the stage's (equal to a 1-replica run)."""
+        return ray_tpu.get(
+            [self.actors[k * self._replicas].grads.remote(mean)
+             for k in range(self.num_stages)], timeout=600)
 
     def reset(self):
         ray_tpu.get([a.reset.remote() for a in self.actors], timeout=60)
@@ -900,6 +1211,7 @@ class Pipeline:
                            timeout=60)
 
     def shutdown(self):
+        self._destroy_collective_groups()
         for a in self.actors:
             try:
                 ray_tpu.kill(a)

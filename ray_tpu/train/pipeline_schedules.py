@@ -73,6 +73,43 @@ SCHEDULES = {
 }
 
 
+def replica_orders(schedule_fn, num_stages: int,
+                   mb_ids_by_replica: List[List[int]]
+                   ) -> List[List[List[Op]]]:
+    """Generalize a per-stage schedule to per-(stage, replica) op
+    orders (r18 PP x DP): replica ``rep`` of every stage runs the base
+    schedule over ITS microbatch subset ``mb_ids_by_replica[rep]``
+    (microbatch mb is assigned to replica mb mod R, so activations flow
+    stage k replica rep -> stage k+1 replica rep — R independent
+    1-wide pipelines sharing the stage programs). Returns
+    ``orders[stage][replica]`` as ops over the GLOBAL microbatch ids;
+    a replica with no microbatches this wave gets an empty order."""
+    out: List[List[List[Op]]] = []
+    for k in range(num_stages):
+        row: List[List[Op]] = []
+        for ids in mb_ids_by_replica:
+            if not ids:
+                row.append([])
+                continue
+            base = schedule_fn(num_stages, len(ids))[k]
+            row.append([(op, ids[i]) for op, i in base])
+        out.append(row)
+    return out
+
+
+def validate_replica_orders(orders: List[List[List[Op]]]) -> None:
+    """Validate each replica's S-stage slice independently with the
+    plain simulator: deps never cross replicas (microbatch ids are
+    opaque to ``validate_order`` and each global id appears in exactly
+    one replica's lanes), so per-replica validity IS gang validity."""
+    if not orders:
+        return
+    for rep in range(len(orders[0])):
+        slice_ = [orders[k][rep] for k in range(len(orders))]
+        if any(slice_):
+            validate_order(slice_)
+
+
 def _check(num_stages: int, num_microbatches: int):
     if num_stages < 1:
         raise ValueError(f"num_stages must be >= 1, got {num_stages}")

@@ -85,6 +85,97 @@ class _Member:
             group_name=group_name, transport="object")
         return [float(o[0]) for o in outs]
 
+    # ------------------------------------------ r18 ring/tree members
+
+    def do_ar(self, group_name, n, transport, dtype="float32",
+              op="sum", noncontig=False, chunk_bytes=None,
+              timeout=60.0):
+        """Seeded deterministic input per rank; returns the allreduce
+        result as float64 (small n — rides the reply inline)."""
+        from ray_tpu import collective
+
+        x = _rank_input(self.rank, n, dtype, noncontig)
+        out = collective.allreduce(x, group_name=group_name, op=op,
+                                   transport=transport,
+                                   timeout=timeout,
+                                   chunk_bytes=chunk_bytes)
+        return np.asarray(out, np.float64)
+
+    def do_ar_inplace_noncontig(self, group_name, n, transport):
+        """In-place contract on a writable NON-contiguous view."""
+        from ray_tpu import collective
+
+        base = np.zeros(2 * n, np.float32)
+        view = base[::2]
+        view[:] = _rank_input(self.rank, n, "float32", False)
+        collective.allreduce(view, group_name=group_name,
+                             transport=transport, timeout=60)
+        return np.asarray(view, np.float64)
+
+    def do_rs(self, group_name, n, transport):
+        from ray_tpu import collective
+
+        x = _rank_input(self.rank, n, "float32", False)
+        out = collective.reduce_scatter(x, group_name=group_name,
+                                        transport=transport,
+                                        timeout=60)
+        return np.asarray(out, np.float64)
+
+    def do_ag(self, group_name, n, transport):
+        from ray_tpu import collective
+
+        x = np.full(n, float(self.rank), np.float32)
+        outs = collective.allgather(x, group_name=group_name,
+                                    transport=transport, timeout=60)
+        return [float(o[0]) for o in outs]
+
+    def do_slow_ar(self, group_name, n, delay_s, timeout):
+        import time
+
+        from ray_tpu import collective
+
+        time.sleep(delay_s)
+        out = collective.allreduce(
+            np.full(n, self.rank + 1.0, np.float32),
+            group_name=group_name, transport="ring", timeout=timeout)
+        return float(out[0])
+
+    def do_jnp_ar(self, group_name, n):
+        """psum semantics through the ring: each process contributes a
+        jax array of ones; the reduce must equal the world size."""
+        import jax.numpy as jnp
+
+        from ray_tpu import collective
+
+        out = collective.allreduce(jnp.ones((n,), jnp.float32),
+                                   group_name=group_name,
+                                   transport="ring", timeout=60)
+        return float(np.asarray(out)[0]), float(np.asarray(out)[-1])
+
+
+def _rank_input(rank, n, dtype, noncontig):
+    """Deterministic per-rank tensor shared by members and the oracle."""
+    if dtype == "bfloat16":
+        import ml_dtypes  # registers the dtype with numpy
+
+        dtype = ml_dtypes.bfloat16
+    rng = np.random.default_rng(1000 + rank)
+    x = (rng.standard_normal(2 * n if noncontig else n)
+         .astype(np.float32))
+    if noncontig:
+        x = x[::2]
+    return x.astype(dtype)
+
+
+def _oracle(world, n, dtype, op="sum", noncontig=False):
+    """numpy reference in the SAME dtype, rank order."""
+    import functools
+
+    ufunc = {"sum": np.add, "max": np.maximum}[op]
+    parts = [np.ascontiguousarray(_rank_input(r, n, dtype, noncontig))
+             for r in range(world)]
+    return functools.reduce(ufunc, parts)
+
 
 class TestCollective:
     def test_allreduce_broadcast_allgather_barrier(self, rt):
@@ -96,25 +187,33 @@ class TestCollective:
                    for r in range(world)]
         collective.create_collective_group(
             members, world, list(range(world)), group_name="g1")
+        try:
+            outs = ray_tpu.get(
+                [m.do_allreduce.remote("g1") for m in members],
+                timeout=120)
+            expected = np.full(4, 1.0 + 2.0 + 3.0)
+            for out in outs:
+                np.testing.assert_allclose(out, expected)
 
-        outs = ray_tpu.get(
-            [m.do_allreduce.remote("g1") for m in members], timeout=120)
-        expected = np.full(4, 1.0 + 2.0 + 3.0)
-        for out in outs:
-            np.testing.assert_allclose(out, expected)
+            outs = ray_tpu.get(
+                [m.do_broadcast.remote("g1") for m in members],
+                timeout=120)
+            for out in outs:
+                np.testing.assert_allclose(out, np.zeros(3))  # src 0
 
-        outs = ray_tpu.get(
-            [m.do_broadcast.remote("g1") for m in members], timeout=120)
-        for out in outs:
-            np.testing.assert_allclose(out, np.zeros(3))  # src_rank 0
+            outs = ray_tpu.get(
+                [m.do_allgather.remote("g1") for m in members],
+                timeout=120)
+            for out in outs:
+                assert [int(x[0]) for x in out] == [0, 1, 2]
 
-        outs = ray_tpu.get(
-            [m.do_allgather.remote("g1") for m in members], timeout=120)
-        for out in outs:
-            assert [int(x[0]) for x in out] == [0, 1, 2]
-
-        assert all(ray_tpu.get(
-            [m.do_barrier.remote("g1") for m in members], timeout=120))
+            assert all(ray_tpu.get(
+                [m.do_barrier.remote("g1") for m in members],
+                timeout=120))
+        finally:
+            # leaked members starve later tests of worker slots (the
+            # shared runtime caps workers per node)
+            _cleanup(members, "g1")
 
     def test_object_plane_collectives(self, rt):
         """Sized payloads ride the object plane (reduce-scatter +
@@ -213,11 +312,270 @@ class TestCollective:
                    for r in range(world)]
         collective.create_collective_group(
             members, world, [0, 1], group_name="g2")
-        outs = ray_tpu.get([
-            members[0].do_allreduce.remote("g2"),
-            members[1].do_allreduce.remote("g2")], timeout=120)
-        np.testing.assert_allclose(outs[0], np.full(4, 3.0))
+        try:
+            outs = ray_tpu.get([
+                members[0].do_allreduce.remote("g2"),
+                members[1].do_allreduce.remote("g2")], timeout=120)
+            np.testing.assert_allclose(outs[0], np.full(4, 3.0))
+        finally:
+            _cleanup(members, "g2")
 
+
+def _mk_group(world, group_name, num_cpus=0, strategies=None):
+    """Spawn world members + gang-init their collective group."""
+    from ray_tpu import collective
+
+    cls = ray_tpu.remote(_Member)
+    members = []
+    for r in range(world):
+        opts = {"num_cpus": num_cpus}
+        if strategies is not None:
+            opts["scheduling_strategy"] = strategies[r]
+        members.append(cls.options(**opts).remote(r, world))
+    collective.create_collective_group(
+        members, world, list(range(world)), group_name=group_name)
+    return members
+
+
+def _cleanup(members, group_name):
+    import contextlib
+
+    from ray_tpu import collective
+
+    for m in members:
+        with contextlib.suppress(Exception):
+            ray_tpu.kill(m)
+    with contextlib.suppress(Exception):
+        collective.destroy_collective_group(group_name)
+
+
+class TestRingCollectives:
+    """r18 object-plane transports: chunked ring + halving-doubling
+    tree vs a numpy oracle, across dtypes / rank counts / transports,
+    plus the group-failure contract."""
+
+    def test_ring_matrix_dtypes_and_ops(self, rt):
+        """Worlds 2 and 3, ring transport: f32, bf16 and non-contiguous
+        inputs must match the rank-order numpy oracle (bf16 within
+        reassociation tolerance — the ring folds in ring order)."""
+        import ml_dtypes
+
+        n = 4096
+        for world in (2, 3):
+            g = f"ring_m{world}"
+            members = _mk_group(world, g)
+            try:
+                for dtype, rtol, atol in (
+                        ("float32", 1e-5, 1e-5),
+                        (str(np.dtype(ml_dtypes.bfloat16)), 5e-2, 5e-2)):
+                    outs = ray_tpu.get(
+                        [m.do_ar.remote(g, n, "ring", dtype=dtype)
+                         for m in members], timeout=120)
+                    ref = np.asarray(_oracle(world, n, dtype),
+                                     np.float64)
+                    for out in outs:
+                        np.testing.assert_allclose(out, ref, rtol=rtol,
+                                                   atol=atol)
+                # max op rides the same ring
+                outs = ray_tpu.get(
+                    [m.do_ar.remote(g, n, "ring", op="max")
+                     for m in members], timeout=120)
+                ref = np.asarray(_oracle(world, n, "float32", op="max"),
+                                 np.float64)
+                for out in outs:
+                    np.testing.assert_allclose(out, ref, rtol=1e-6)
+                # non-contiguous INPUT LAYOUT (strided view), in-place
+                # contract: same values as the f32 leg, so the same
+                # oracle — only the memory layout differs
+                outs = ray_tpu.get(
+                    [m.do_ar_inplace_noncontig.remote(g, n, "ring")
+                     for m in members], timeout=120)
+                ref = np.asarray(_oracle(world, n, "float32"),
+                                 np.float64)
+                for out in outs:
+                    np.testing.assert_allclose(out, ref, rtol=1e-5,
+                                               atol=1e-5)
+            finally:
+                _cleanup(members, g)
+
+    def test_ring_chunked_worlds_4_8_and_tree(self, rt):
+        """Larger worlds: ring with a small chunk_bytes (multiple
+        chunks per slice — the warmed streaming path) at 4 and 8 ranks,
+        and the halving-doubling tree on the power-of-two worlds."""
+        n = 50_000  # ~200 KB f32: 4 chunks per slice at 16 KiB chunks
+        for world in (4, 8):
+            g = f"ring_w{world}"
+            members = _mk_group(world, g)
+            try:
+                outs = ray_tpu.get(
+                    [m.do_ar.remote(g, n, "ring", chunk_bytes=16384)
+                     for m in members], timeout=180)
+                ref = np.asarray(_oracle(world, n, "float32"),
+                                 np.float64)
+                for out in outs:
+                    np.testing.assert_allclose(out, ref, rtol=1e-5,
+                                               atol=1e-5)
+                outs = ray_tpu.get(
+                    [m.do_ar.remote(g, n, "tree") for m in members],
+                    timeout=180)
+                for out in outs:
+                    np.testing.assert_allclose(out, ref, rtol=1e-5,
+                                               atol=1e-5)
+            finally:
+                _cleanup(members, g)
+
+    def test_tree_rejects_non_power_of_two(self, rt):
+        members = _mk_group(3, "tree_np2")
+        try:
+            with pytest.raises(Exception, match="power-of-two"):
+                ray_tpu.get([m.do_ar.remote("tree_np2", 64, "tree")
+                             for m in members], timeout=60)
+        finally:
+            _cleanup(members, "tree_np2")
+
+    def test_reduce_scatter_and_allgather_ring(self, rt):
+        """reduce_scatter returns rank r's slice of the reduce
+        (np.array_split convention); ring allgather returns every
+        rank's tensor, in rank order — both store-to-store."""
+        world, n = 3, 30_000
+        g = "ring_rs"
+        members = _mk_group(world, g)
+        try:
+            outs = ray_tpu.get([m.do_rs.remote(g, n, "ring")
+                                for m in members], timeout=120)
+            ref = np.asarray(_oracle(world, n, "float32"), np.float64)
+            exp = np.array_split(ref, world)
+            for r, out in enumerate(outs):
+                np.testing.assert_allclose(out, exp[r], rtol=1e-5,
+                                           atol=1e-5)
+            # rendezvous escape hatch computes the same slices
+            outs = ray_tpu.get([m.do_rs.remote(g, n, "rendezvous")
+                                for m in members], timeout=120)
+            for r, out in enumerate(outs):
+                np.testing.assert_allclose(out, exp[r], rtol=1e-5,
+                                           atol=1e-5)
+            ag = ray_tpu.get([m.do_ag.remote(g, 20_000, "ring")
+                              for m in members], timeout=120)
+            for firsts in ag:
+                assert firsts == [0.0, 1.0, 2.0]
+        finally:
+            _cleanup(members, g)
+
+    def test_rendezvous_transport_full_matrix(self, rt):
+        """The escape hatch stays green across the kinds: explicit
+        transport="rendezvous" (inline under the threshold, slice
+        exchange above) agrees with the oracle for allreduce, and the
+        gather/broadcast/barrier kinds keep working through the same
+        group."""
+        from ray_tpu import collective  # noqa: F401 — group teardown
+
+        world = 3
+        g = "rdv_m"
+        members = _mk_group(world, g)
+        try:
+            for n in (512, 200_000):  # inline and slice-exchange legs
+                outs = ray_tpu.get(
+                    [m.do_ar.remote(g, n, "rendezvous")
+                     for m in members], timeout=120)
+                ref = np.asarray(_oracle(world, n, "float32"),
+                                 np.float64)
+                for out in outs:
+                    np.testing.assert_allclose(out, ref, rtol=1e-5,
+                                               atol=1e-5)
+            outs = ray_tpu.get([m.do_ag.remote(g, 256, "rendezvous")
+                                for m in members], timeout=120)
+            for firsts in outs:
+                assert firsts == [0.0, 1.0, 2.0]
+            outs = ray_tpu.get([m.do_broadcast.remote(g)
+                                for m in members], timeout=120)
+            for out in outs:
+                np.testing.assert_allclose(out, np.zeros(3))
+            assert all(ray_tpu.get([m.do_barrier.remote(g)
+                                    for m in members], timeout=120))
+        finally:
+            _cleanup(members, g)
+
+    def test_rendezvous_incremental_reduce(self):
+        """Satellite: the coordinator folds reduce contributions as
+        they LAND — after two of three ranks arrived the round holds
+        one accumulator, not a per-rank parts map (O(1) payloads)."""
+        import threading
+        import time
+
+        from ray_tpu import collective
+
+        rv = collective.Rendezvous(3)
+        results = {}
+
+        def contrib(rank):
+            results[rank] = rv.contribute(
+                "allreduce", 1, rank, np.full(4, rank + 1.0),
+                op="sum", timeout=10)
+
+        threads = [threading.Thread(target=contrib, args=(r,))
+                   for r in (0, 1)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5
+        state = None
+        while time.monotonic() < deadline:
+            state = rv._rounds.get(("allreduce", 1))
+            if state is not None and state["arrived"] == 2:
+                break
+            time.sleep(0.01)
+        assert state is not None and state["arrived"] == 2
+        assert state["parts"] == {}, "reduce kinds must not hold parts"
+        assert state["acc"] is not None
+        np.testing.assert_allclose(state["acc"], np.full(4, 3.0))
+        contrib(2)
+        for t in threads:
+            t.join(timeout=5)
+        for r in range(3):
+            np.testing.assert_allclose(results[r], np.full(4, 6.0))
+        assert rv._rounds == {}  # fully claimed -> dropped
+
+    def test_rendezvous_timeout_drops_round(self):
+        """A timed-out round is removed so the surviving group's next
+        operation doesn't rendezvous with stale arrivals."""
+        from ray_tpu import collective
+
+        rv = collective.Rendezvous(2)
+        with pytest.raises(TimeoutError):
+            rv.contribute("allreduce", 1, 0, np.ones(2), timeout=0.2)
+        assert rv._rounds == {}
+        # the same seq can rendezvous cleanly afterwards
+        import threading
+
+        out = {}
+
+        def late():
+            out["r"] = rv.contribute("allreduce", 1, 1, np.ones(2),
+                                     timeout=5)
+
+        t = threading.Thread(target=late)
+        t.start()
+        mine = rv.contribute("allreduce", 1, 0, np.ones(2), timeout=5)
+        t.join(timeout=5)
+        np.testing.assert_allclose(mine, np.full(2, 2.0))
+        np.testing.assert_allclose(out["r"], np.full(2, 2.0))
+
+    def test_algorithm_desync_raises_clean(self, rt):
+        """Ranks forcing DIFFERENT algorithms (ring vs inline) must
+        fail with a clean CollectiveError on both sides — the tagged
+        rounds detect the mismatch instead of wedging the group."""
+        g = "desync"
+        members = _mk_group(2, g)
+        try:
+            refs = [members[0].do_ar.remote(g, 1000, "ring"),
+                    members[1].do_ar.remote(g, 1000, "inline")]
+            errs = 0
+            for ref in refs:
+                with pytest.raises(Exception, match="desync|slice"):
+                    ray_tpu.get(ref, timeout=60)
+                errs += 1
+            assert errs == 2
+        finally:
+            _cleanup(members, g)
 
 class TestJaxGang:
     # Known environment limitation (fails identically on the seed): the
@@ -225,9 +583,12 @@ class TestJaxGang:
     # sandboxed CI container — the gang workers hang in
     # jax.distributed.initialize's coordination-service handshake, so
     # trainer.fit() returns without the workers' reported metrics
-    # (KeyError 'process_count'). The single-process collective paths
-    # above cover the transport; this case needs a host where the
-    # coordinator's cross-process gRPC channel works. Set
+    # (KeyError 'process_count'). The psum NUMERICS are covered without
+    # the handshake by
+    # TestRingCollectives.test_psum_numerics_via_ring_collective (r18 —
+    # same ones-reduce over a gang, driven through the object-plane
+    # ring on virtual nodes); only this true multi-process
+    # jax.distributed leg keeps the xfail. Set
     # RAY_TPU_EXPECT_JAX_DISTRIBUTED=1 to force it to count (e.g. on
     # real multi-host TPU CI). Non-strict: an environment where it
     # starts passing just records XPASS.
@@ -334,3 +695,92 @@ class TestTpuChipAssignment:
             assert env1 == ",".join(str(i) for i in ids1)
         finally:
             ray_tpu.shutdown()
+
+
+# ================================== r18 virtual-cluster legs (own
+# clusters: they must not share the module fixture's runtime, and like
+# TestTpuChipAssignment they run after it has been torn down)
+
+
+def test_rank_node_death_mid_ring_is_clean():
+    """Chaos: a rank's NODE dying mid-collective surfaces a clean
+    CollectiveError on the surviving ranks within the op timeout (no
+    hang past the get bound), and a fresh group on the survivors still
+    works — the dead round never wedges the coordinator."""
+    import time
+
+    from ray_tpu import collective
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.api import NodeAffinitySchedulingStrategy
+
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2, "num_tpus": 0})
+    try:
+        n1 = cluster.add_node(num_cpus=2)
+        n2 = cluster.add_node(num_cpus=2)
+        g = "chaos_ring"
+        strategies = [
+            NodeAffinitySchedulingStrategy(0, soft=False),
+            NodeAffinitySchedulingStrategy(n1, soft=False),
+            NodeAffinitySchedulingStrategy(n2, soft=False),
+        ]
+        members = _mk_group(3, g, num_cpus=1, strategies=strategies)
+        # ranks 0/1 enter the ring immediately; rank 2 (on the doomed
+        # node) stalls first, so the group is mid-collective when the
+        # node dies and rank 2 never arrives
+        refs = [members[0].do_slow_ar.remote(g, 4096, 0.0, 6.0),
+                members[1].do_slow_ar.remote(g, 4096, 0.0, 6.0),
+                members[2].do_slow_ar.remote(g, 4096, 3.0, 6.0)]
+        time.sleep(0.8)
+        t0 = time.monotonic()
+        cluster.remove_node(n2)
+        for ref in refs[:2]:
+            with pytest.raises(Exception,
+                               match="Collective|collective|died"):
+                ray_tpu.get(ref, timeout=45)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 40, f"group wedged for {elapsed:.1f}s"
+        # the surviving pair forms a fresh group and reduces cleanly
+        g2 = "chaos_ring2"
+        collective.create_collective_group(
+            members[:2], 2, [0, 1], group_name=g2)
+        outs = ray_tpu.get([m.do_ar.remote(g2, 2048, "ring")
+                            for m in members[:2]], timeout=60)
+        ref = np.asarray(_oracle(2, 2048, "float32"), np.float64)
+        for out in outs:
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+        _cleanup(members[:2], g2)
+        _cleanup(members, g)
+    finally:
+        cluster.shutdown()
+
+
+def test_psum_numerics_via_ring_collective():
+    """Satellite rework of the long-standing psum xfail: the SAME
+    numerics — every process contributes ones, the gang-reduce must
+    equal the process count — driven through the r18 ring on virtual
+    nodes, no jax.distributed handshake required. The true
+    multi-process jax.distributed leg stays in TestJaxGang as the
+    (env-limited, non-strict) xfail."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.api import NodeAffinitySchedulingStrategy
+
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2, "num_tpus": 0})
+    try:
+        n1 = cluster.add_node(num_cpus=2)
+        g = "psum_ring"
+        strategies = [NodeAffinitySchedulingStrategy(0, soft=False),
+                      NodeAffinitySchedulingStrategy(n1, soft=False)]
+        members = _mk_group(2, g, num_cpus=1, strategies=strategies)
+        try:
+            outs = ray_tpu.get([m.do_jnp_ar.remote(g, 8192)
+                                for m in members], timeout=120)
+            for first, last in outs:
+                assert first == last == 2.0  # psum of ones over world
+        finally:
+            _cleanup(members, g)
+    finally:
+        cluster.shutdown()

@@ -124,7 +124,13 @@ def test_summary_tasks_phase_percentiles_smoke(ray_start):
                                         timeout=30) as r:
                 summ = json.loads(r.read())
             phases = summ.get("phases", {}).get("phase_probe", {})
-            if want <= set(phases):
+            # wait for the COUNTS, not just the phase keys: each
+            # worker's event buffer flushes on its own ~1s cadence, so
+            # under a loaded suite the first batches can land with
+            # only part of the 6 tasks folded — breaking on keys alone
+            # raced the remaining flushes (r18 deflake)
+            if want <= set(phases) and all(
+                    phases[p].get("count", 0) >= 6 for p in want):
                 break
             time.sleep(0.3)  # worker event buffers flush on a 1s period
         assert want <= set(phases), phases

@@ -690,7 +690,11 @@ def test_head_crash_restart_cluster_survives(tmp_path):
                                  str(tmp_path / "head2.log"))
 
         # the SAME driver finishes its in-flight workload
-        assert ray_tpu.get(refs, timeout=120) == [i * 2 for i in range(6)]
+        # generous bound (r18 deflake): under a loaded suite the
+        # restarted head's boot + agent re-registration + grace window
+        # + lease replay can stack to minutes before the in-flight
+        # tasks resume — the assertion is about COMPLETION, not speed
+        assert ray_tpu.get(refs, timeout=300) == [i * 2 for i in range(6)]
         # the named actor answers AND kept its pre-crash state (the
         # surviving worker re-claimed it; a WAL reschedule would have
         # reset the counter)
