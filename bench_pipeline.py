@@ -947,6 +947,46 @@ def bench_dp(pairs: int) -> dict:
         st2 = pipe2.stats()
         coll_row = state.object_plane_stats().get("collective", {})
         lag_delta = lag.delta()
+        # r19 comm-aware trace analysis over the session's timeline:
+        # how much of the batch-end grad all-reduce the tail backward
+        # waves actually hid (the overlap the lane-local AR sequencing
+        # exists to create). Session-wide — warmup and 1-replica pairs
+        # are in the union too — so read it as an indicator, not a
+        # per-run measurement.
+        analysis = {}
+        try:
+            from ray_tpu import tracing
+
+            deadline = time.monotonic() + 15
+            events = []
+            while time.monotonic() < deadline:
+                events = tracing.timeline()
+                if any(e.get("cat") == "comm" and
+                       e["name"].startswith("comm.ar.")
+                       for e in events):
+                    break
+                time.sleep(0.5)  # worker buffers flush on a 1s period
+            res = tracing.analyze(events=events)
+            ar = [sp for sp in res["comm_spans"]
+                  if sp["name"].startswith("comm.ar.")]
+            ar_s = sum(sp["dur_s"] for sp in ar)
+            analysis = {
+                "total_comm_s": round(res["total"]["comm_s"], 4),
+                "exposed_comm_s": round(
+                    res["total"]["exposed_comm_s"], 4),
+                "exposed_comm_frac": round(
+                    res["total"]["exposed_comm_frac"], 4),
+                "mean_lane_utilization": round(
+                    res["total"]["utilization"], 4),
+                "ar_spans": len(ar),
+                "ar_comm_s": round(ar_s, 4),
+                "ar_hidden_frac": round(
+                    sum(sp["dur_s"] * sp["overlap_frac"]
+                        for sp in ar) / max(1e-9, ar_s), 4),
+                "critical_path_s": round(res["critical_path_s"], 3),
+            }
+        except Exception as e:  # noqa: BLE001 — analysis must never
+            analysis = {"error": repr(e)[:200]}  # fail the bench
         pipe1.shutdown()
         pipe2.shutdown()
     finally:
@@ -972,6 +1012,7 @@ def bench_dp(pairs: int) -> dict:
         "replica_sync_max_err": sync_err,
         "grad_allreduces": st2["grad_allreduces"],
         "collective_counters": coll_row,
+        "exposed_comm_analysis": analysis,
         "gate_wall_ratio_le_0_65": ratio <= 0.65,
         "gate_grads_equal_oracle": bool(grad_err < 1e-5
                                         and loss_err < 1e-6),

@@ -86,7 +86,10 @@ class Cluster:
         while time.monotonic() < deadline:
             new = set(self.head.nodes) - known
             if new:
-                return RemoteNodeHandle(proc, new.pop())
+                idx = new.pop()
+                node = self.head.nodes.get(idx)
+                return RemoteNodeHandle(
+                    proc, idx, getattr(node, "store_name", ""))
             if proc.poll() is not None:
                 out = proc.stdout.read().decode(errors="replace")
                 raise RuntimeError(f"node agent died: {out[-2000:]}")
@@ -220,15 +223,28 @@ class NodeKiller:
 
 
 class RemoteNodeHandle:
-    def __init__(self, proc, node_idx: int):
+    def __init__(self, proc, node_idx: int, store_name: str = ""):
         self.proc = proc
         self.node_idx = node_idx
+        #: the agent's /dev/shm arena file name, so terminate() can
+        #: sweep it — SIGKILL gives the agent no chance to unlink its
+        #: own arena, and each orphan pins object_store_memory bytes of
+        #: shared memory until someone removes it (ROADMAP 5c)
+        self.store_name = store_name
 
     def terminate(self):
-        """Kill the agent process (simulates host loss)."""
+        """Kill the agent process (simulates host loss) and sweep its
+        leaked /dev/shm arena."""
         if self.proc.poll() is None:
             try:
                 self.proc.kill()
             except OSError:
                 pass
         self.proc.wait(timeout=10)
+        if self.store_name:
+            import os
+
+            try:
+                os.unlink(f"/dev/shm/{self.store_name}")
+            except OSError:
+                pass

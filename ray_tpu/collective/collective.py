@@ -569,6 +569,18 @@ def _trace(label: str, t0: float) -> float:
     return now
 
 
+def _comm_span(name: str, t0_mono: float):
+    """Retroactively stamp [t0_mono, now) as a ``comm.<name>`` timeline
+    span (r19): collective hops land in the same Perfetto lanes as the
+    compute that should hide them, and trace_analysis.analyze() reads
+    the exposed remainder. No-op outside a CoreContext."""
+    from ray_tpu import tracing
+
+    now_m, now_w = time.monotonic(), time.time()
+    tracing.record_comm_span(name, now_w - (now_m - t0_mono), now_w,
+                             t0_mono, now_m)
+
+
 def _ring_chunk_bytes(chunk_bytes: Optional[int]) -> int:
     if chunk_bytes is not None:
         return int(chunk_bytes)
@@ -643,6 +655,7 @@ def _ring_collective(arr: np.ndarray, st: _GroupState, op: str,
                                  timeout)
             prev_refs = refs
             _trace(f"h{s}.pull_fold", t)
+            _comm_span(f"{kind}.ring.h{s}", t_hop)
             m["hop_s"].observe(time.monotonic() - t_hop,
                                {"algorithm": "ring"})
         # rank r now holds the fully-reduced slice r. Publish it only
@@ -674,6 +687,7 @@ def _ring_collective(arr: np.ndarray, st: _GroupState, op: str,
                 recv += _copy_chunks(views[q], grid[q]["chunks"],
                                      timeout)
             t = _trace("ag.pull", t)
+        _comm_span(f"{kind}.ring.ag", t_hop)
         m["hop_s"].observe(time.monotonic() - t_hop,
                            {"algorithm": "ring"})
         if allgather_phase:
@@ -687,9 +701,11 @@ def _ring_collective(arr: np.ndarray, st: _GroupState, op: str,
                  timeout=timeout)
             _trace("barrier", t)
             del my_refs
+            _comm_span(f"{kind}.ring", t_setup)
             return flat.reshape(arr.shape)
         # reduce_scatter hands the slice out as an independent array
         # (the flat buffer may alias the caller's tensor)
+        _comm_span(f"{kind}.ring", t_setup)
         return np.array(views[r], copy=True)
     except CollectiveError:
         raise
@@ -714,6 +730,7 @@ def _tree_allreduce(arr: np.ndarray, st: _GroupState, op: str,
     payloads, far fewer latency-bound hops for small ones). Power-of-two
     world sizes only; ``auto`` falls back to the ring otherwise."""
     m = _m()
+    t_setup = time.monotonic()
     W, r = st.world_size, st.rank
     ufunc = _UFUNCS[op]
     chunk_bytes = _ring_chunk_bytes(chunk_bytes)
@@ -742,12 +759,14 @@ def _tree_allreduce(arr: np.ndarray, st: _GroupState, op: str,
                                  timeout)
             prev_refs = refs
             _trace(f"t{t}.hop", t_hop)
+            _comm_span(f"allreduce.tree.t{t}", t_hop)
             m["hop_s"].observe(time.monotonic() - t_hop,
                                {"algorithm": "tree"})
         _run("exchange", st.name, {"alg": "tree", "hop": rounds,
                                    "meta": None, "chunks": None},
              timeout=timeout)
         prev_refs = None
+        _comm_span("allreduce.tree", t_setup)
         return acc.reshape(arr.shape)
     except CollectiveError:
         raise
@@ -804,6 +823,7 @@ def _object_allgather(arr: np.ndarray, st: _GroupState, timeout: float,
                                    "meta": None, "chunks": None},
              timeout=timeout)
         del refs
+        _comm_span("allgather.object", t_hop)
         return out
     except CollectiveError:
         raise
@@ -830,6 +850,7 @@ def _rendezvous_allreduce(arr: np.ndarray, st: _GroupState, op: str,
     t0 = time.monotonic()
     out = _run("allreduce", st.name, np.ascontiguousarray(arr), op=op,
                timeout=timeout)
+    _comm_span("allreduce.rendezvous", t0)
     m["hop_s"].observe(time.monotonic() - t0,
                        {"algorithm": "rendezvous"})
     m["ops"].inc(1.0, {"algorithm": "rendezvous", "kind": "allreduce"})

@@ -533,6 +533,25 @@ class Config:
     # dashboard/modules/reporter/reporter_agent.py, 2.5s). <= 0 disables
     # the reporter thread.
     node_telemetry_period_s: float = 2.0
+    # Flight recorder (r19): the head samples its merged metric table
+    # every `timeseries_sample_s` seconds into per-series ring buffers —
+    # counters folded to per-second rates, gauges as-is, histograms to
+    # p50/p95/p99 point estimates. The fine ring keeps the most recent
+    # `timeseries_window_s` seconds at full sample resolution; samples
+    # that age out are 8:1 downsampled (mean) into a coarse ring
+    # covering ~8x the window, so a post-hoc `state.metrics_history()`
+    # or `/api/timeseries` query can still see the shape of an hour-old
+    # incident at reduced resolution. Memory is bounded per series:
+    # window_s/sample_s fine points + window_s/sample_s coarse points.
+    # <= 0 sample period disables the recorder entirely.
+    timeseries_sample_s: float = 1.0
+    timeseries_window_s: float = 300.0
+    # Object-plane transfers (pull/push/prefetch) below this byte size
+    # do NOT emit comm.* timeline spans; tiny control-sized objects
+    # would otherwise flood the task-event ring with microsecond spans
+    # that no overlap analysis cares about. Collective hops always
+    # emit spans regardless of size (they are the workload).
+    transfer_span_min_bytes: int = 65536
 
     # --- TPU ---
     # Override autodetected TPU topology, e.g. "v5p-64".

@@ -203,10 +203,15 @@ class TaskEventBuffer:
 
     def record(self, task_id_hex: str, name: str, state: str,
                error: str = "", trace_id: str = "", span_id: str = "",
-               parent_span_id: str = ""):
+               parent_span_id: str = "", ts: Optional[float] = None,
+               mono: Optional[float] = None):
+        # ts/mono default to "now"; retroactive emitters (r19 comm
+        # transfer spans, stamped at completion with the measured start)
+        # pass both explicitly so the interval lands where it happened
         ev = (task_id_hex, name, state, self._worker_id, self._node_idx,
-              time.time(), error, trace_id, span_id, parent_span_id,
-              time.monotonic())
+              time.time() if ts is None else ts, error, trace_id,
+              span_id, parent_span_id,
+              time.monotonic() if mono is None else mono)
         if len(self._events) == self._max:
             self._dropped += 1  # deque(maxlen) evicts the oldest
         self._events.append(ev)

@@ -43,6 +43,7 @@ from .resources import NodeResources, ResourceSet, detect_node_resources
 from .scheduler import ClusterResourceScheduler
 from .serialization import dumps, loads
 from .task_spec import ARG_REF, PlacementGroupSpec, TaskSpec
+from .timeseries import FlightRecorder
 
 
 @dataclass
@@ -393,6 +394,18 @@ class Head:
         self.task_events: "deque" = deque(
             maxlen=get_config().task_event_buffer_size)
         self.task_events_dropped = 0
+        # Total events ever ingested into the ring — the absolute
+        # sequence base for the paged task_events query (r19): ring
+        # position i holds sequence (task_events_seq - len(ring) + i).
+        self.task_events_seq = 0
+        # Flight recorder (r19): periodic() folds the merged metric
+        # table into bounded ring-buffer series every
+        # timeseries_sample_s (STATE_QUERY "metrics_history" /
+        # /api/timeseries read them back).
+        self.recorder = FlightRecorder(
+            get_config().timeseries_sample_s,
+            get_config().timeseries_window_s)
+        self._ts_last_sample = 0.0
         # Off-loop event folding (r11): TASK_EVENTS batches from the wire
         # land in this bounded queue and a dedicated fold thread does the
         # timeline/histogram work — the commutative fold makes the move
@@ -3505,6 +3518,7 @@ class Head:
             overflow = max(0, len(self.task_events) + len(batch)
                            - self.task_events.maxlen)
             self.task_events.extend(batch)
+            self.task_events_seq += len(batch)
             self.task_events_dropped += dropped + overflow
             for ev in batch:
                 self._fold_task_event(ev)
@@ -3901,6 +3915,43 @@ class Head:
             conn.reply(rid, [self._task_phase_summary(
                 funcs, include_raw=True)])
             return
+        if isinstance(kind, str) and kind.startswith("metrics_history"):
+            # "metrics_history" or "metrics_history:<window_s>:<names>"
+            # — flight-recorder readback (r19). window_s empty/0 means
+            # the full fine window; names are comma-separated exact
+            # keys, prefixes, or fnmatch globs ("collective.*").
+            _, _, spec = kind.partition(":")
+            win_s, _, names_s = spec.partition(":")
+            names = [n for n in names_s.split(",") if n] or None
+            window = float(win_s) if win_s else None
+            conn.reply(rid, [self.recorder.history(names, window)])
+            return
+        if isinstance(kind, str) and kind.startswith("task_events_page"):
+            # "task_events_page:<cursor>" — chunked raw-event readback
+            # (r19). Replaces timeline()'s single
+            # STATE_QUERY("task_events", 1_000_000) pull: each page is
+            # at most `limit` rows, so a long job's export can never
+            # build one huge reply frame on the head's IO path. The
+            # cursor is an absolute ingest sequence number; a cursor
+            # that has already been evicted from the ring fast-forwards
+            # to the oldest retained event (the ring's drop accounting
+            # covers the gap).
+            _, _, spec = kind.partition(":")
+            cursor = int(spec) if spec else 0
+            with self._timeline_lock:
+                seq = self.task_events_seq
+                ring = self.task_events
+                oldest = seq - len(ring)
+                start = max(cursor, oldest)
+                page = list(itertools.islice(
+                    ring, start - oldest, start - oldest + max(limit, 1)))
+            nxt = start + len(page)
+            conn.reply(rid, [{
+                "rows": [self._fmt_task_event(ev) for ev in page],
+                "next": nxt,
+                "done": nxt >= seq,
+            }])
+            return
         fn = self._STATE_KINDS.get(kind)
         if fn is None:
             conn.reply_error(rid, ValueError(f"unknown kind {kind!r}"))
@@ -4225,18 +4276,23 @@ class Head:
             "message": msg, "extra": extra,
         } for (ts, sev, src, nidx, eid, etype, msg, extra) in recent]
 
-    def _sq_task_events(self, limit):
-        # raw transition log (timeline/tracing export); tolerant
-        # of the pre-r10 10-field shape (no monotonic stamp)
-        with self._timeline_lock:
-            evs = list(self.task_events)
-        return [{
+    @staticmethod
+    def _fmt_task_event(ev):
+        # wire tuple -> state-API dict; tolerant of the pre-r10
+        # 10-field shape (no monotonic stamp)
+        return {
             "task_id": ev[0], "name": ev[1], "state": ev[2],
             "worker_id": ev[3], "node_idx": ev[4], "ts": ev[5],
             "error": ev[6], "trace_id": ev[7], "span_id": ev[8],
             "parent_span_id": ev[9],
             "mono": ev[10] if len(ev) > 10 else None,
-        } for ev in evs]
+        }
+
+    def _sq_task_events(self, limit):
+        # raw transition log (timeline/tracing export)
+        with self._timeline_lock:
+            evs = list(self.task_events)
+        return [self._fmt_task_event(ev) for ev in evs]
 
     def _sq_tasks(self, limit):
         # folded timelines, newest activity first: full state_ts
@@ -4617,6 +4673,15 @@ class Head:
         self.io.probe_lag()
         self._publish_loop_lag_gauges()
         cfg = get_config()
+        # Flight-recorder sampling (r19): fold the merged metric table
+        # (same rows metrics_summary() serves, head built-ins included)
+        # into the bounded ring-buffer series. Wall-clock stamps so
+        # history aligns with timeline() event timestamps.
+        if cfg.timeseries_sample_s > 0:
+            wall = time.time()
+            if wall - self._ts_last_sample >= cfg.timeseries_sample_s:
+                self._ts_last_sample = wall
+                self.recorder.sample(self._sq_metrics(1 << 30), wall)
         now = time.monotonic()
         with self._lock:
             # sweep ghost workers: a spawn whose process died (or whose

@@ -440,6 +440,15 @@ class NodeAgent:
             self.store.close()
         except Exception:
             pass
+        # belt-and-braces arena unlink (r19, ROADMAP 5c): close()
+        # destroys the arena for creators, but if it raised (live
+        # zero-copy borrows, a wedged native lock) the /dev/shm file
+        # would outlive this process and pin its full capacity —
+        # unlinking an already-destroyed name is a harmless ENOENT
+        try:
+            os.unlink(f"/dev/shm/{self.store_name}")
+        except OSError:
+            pass
 
 
 def main(argv=None):
@@ -461,7 +470,23 @@ def main(argv=None):
                       labels=labels or None)
     print(f"node agent joined as node {agent.node_idx} "
           f"(store {agent.store_name})", flush=True)
+    # Arena hygiene (r19, ROADMAP 5c): every exit path must unlink the
+    # /dev/shm arena. SIGTERM/SIGINT flow through run_forever's finally
+    # -> shutdown() -> store destroy; atexit catches a run_forever that
+    # unwound via an exception without reaching shutdown(). Only
+    # SIGKILL leaks, and Cluster's handle.terminate sweep +
+    # doctor_warnings' orphan scan cover that.
+    import atexit
+
+    def _unlink_arena():
+        try:
+            os.unlink(f"/dev/shm/{agent.store_name}")
+        except OSError:
+            pass
+
+    atexit.register(_unlink_arena)
     signal.signal(signal.SIGTERM, lambda *a: agent._shutdown.set())
+    signal.signal(signal.SIGINT, lambda *a: agent._shutdown.set())
     agent.run_forever()
 
 

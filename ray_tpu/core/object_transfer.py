@@ -581,6 +581,23 @@ class ObjectPuller:
             m["pull_latency"].observe(latency_s)
         except Exception:  # noqa: BLE001 — metrics must never fail a pull
             pass
+        # comm-aware timeline (r19): transfers worth analyzing land as
+        # retroactive comm.* spans — stamped once at completion so the
+        # streaming path itself carries no tracing work. Small control
+        # objects stay off the ring (transfer_span_min_bytes); node
+        # agents (no CoreContext) no-op inside record_comm_span.
+        try:
+            if st.size >= get_config().transfer_span_min_bytes:
+                from ray_tpu import tracing
+
+                kind = "prefetch" if st.prefetch and not st.joined \
+                    else "pull"
+                now_m, now_w = time.monotonic(), time.time()
+                tracing.record_comm_span(
+                    f"{kind}.{n_sources}src", now_w - latency_s, now_w,
+                    now_m - latency_s, now_m)
+        except Exception:  # noqa: BLE001 — tracing must never fail a pull
+            pass
 
     # ---- everything below runs on the IO thread, in stream order ----
 

@@ -14,6 +14,7 @@ agent processes. Endpoints:
     /api/metrics            -> merged metric rows (JSON)
     /api/summary/{tasks,actors,objects} -> state summaries
     /api/timeline           -> chrome-trace events (tracing.timeline)
+    /api/timeseries         -> flight-recorder series (state.metrics_history)
     /api/serve/applications -> serve deployment status rows
     /metrics                -> Prometheus text exposition
     /                       -> the SPA (dashboard_ui.py; hash-routed
@@ -84,6 +85,14 @@ class _Handler(BaseHTTPRequestHandler):
                 from ray_tpu import tracing
 
                 self._json(tracing.timeline())
+            elif path == "/api/timeseries":
+                # flight-recorder readback (r19):
+                # ?names=head.loop_lag_ms,collective.*&window_s=60
+                names = [n for n in
+                         query.get("names", "").split(",") if n] or None
+                win = query.get("window_s")
+                self._json(state.metrics_history(
+                    names, float(win) if win else None))
             elif path == "/api/profile":
                 # on-demand flamegraph: ?worker_id=...&duration_s=1&hz=100
                 # (omit worker_id to profile the driver/head process);
@@ -216,7 +225,7 @@ DOCTOR_ENDPOINTS = (
     "/api/cluster", "/api/nodes", "/api/workers", "/api/actors",
     "/api/tasks", "/api/objects", "/api/placement_groups",
     "/api/io_loop", "/api/object_plane", "/api/cluster_events",
-    "/api/metrics", "/api/jobs", "/api/timeline",
+    "/api/metrics", "/api/jobs", "/api/timeline", "/api/timeseries",
     "/api/summary/tasks", "/api/summary/actors", "/api/summary/objects",
     "/api/summary/pipeline", "/api/summary/shuffle",
     "/api/serve/applications",
@@ -256,6 +265,45 @@ _prefetch_last = {"issued": 0, "wasted": 0}
 # Serve autoscaler flap window (r14): direction reversals inside this
 # many seconds are counted against serve_flap_warn_reversals.
 SERVE_FLAP_WINDOW_S = 60.0
+
+
+def orphan_arena_files(shm_dir: str = "/dev/shm") -> list:
+    """Arena hygiene (r19, ROADMAP 5c): ``rtpu_*`` files in /dev/shm
+    that no live process has mapped — the residue of hard-killed agents
+    and crashed sessions; each one pins its full arena size in shared
+    memory until someone unlinks it. Detection is by scanning
+    ``/proc/*/maps`` for the file path (a mapped arena always shows
+    there); a file nobody maps is garbage by definition, whatever
+    session named it. Returns ``[(path, size_bytes)]``."""
+    import os
+
+    try:
+        names = [f for f in os.listdir(shm_dir) if f.startswith("rtpu_")]
+    except OSError:
+        return []
+    if not names:
+        return []
+    candidates = {f"{shm_dir}/{n}" for n in names}
+    try:
+        pids = [p for p in os.listdir("/proc") if p.isdigit()]
+    except OSError:
+        pids = []
+    for pid in pids:
+        if not candidates:
+            break
+        try:
+            with open(f"/proc/{pid}/maps") as fh:
+                txt = fh.read()
+        except OSError:  # raced exit / permission — treat as not-mapping
+            continue
+        candidates = {p for p in candidates if p not in txt}
+    out = []
+    for path in sorted(candidates):
+        try:
+            out.append((path, os.path.getsize(path)))
+        except OSError:  # unlinked while we scanned
+            pass
+    return out
 
 
 def _serve_warnings(apps_status: dict, cfg) -> list:
@@ -311,6 +359,18 @@ def doctor_warnings() -> list:
     from ray_tpu import state
 
     warns = []
+    # arena hygiene (r19): flag leaked /dev/shm arenas FIRST — this
+    # check needs no live cluster (orphans matter most when nothing is
+    # running and the memory is still pinned)
+    orphans = orphan_arena_files()
+    if orphans:
+        total_mb = sum(sz for _, sz in orphans) / (1024 * 1024)
+        names = ", ".join(p for p, _ in orphans[:5])
+        more = f" (+{len(orphans) - 5} more)" if len(orphans) > 5 else ""
+        warns.append(
+            f"{len(orphans)} orphaned arena file(s) in /dev/shm pinning "
+            f"{total_mb:.0f} MB: {names}{more} — left by hard-killed "
+            "agents/sessions; rm them to release the shared memory")
     try:
         rows = state.io_loop_stats()
     except Exception:  # noqa: BLE001 — no cluster up: nothing to warn on

@@ -130,7 +130,50 @@ def cmd_status(args):
     print("resources (available / total):")
     for k in sorted(total):
         print(f"  {k}: {avail.get(k, 0):g} / {total[k]:g}")
+    _print_timeseries_digest()
     return 0
+
+
+def _spark(points, width: int = 24) -> str:
+    """One-line unicode sparkline over [ts, value] points."""
+    if not points:
+        return ""
+    vals = [v for _, v in points][-width:]
+    lo, hi = min(vals), max(vals)
+    bars = "▁▂▃▄▅▆▇█"
+    if hi - lo < 1e-12:
+        return bars[0] * len(vals)
+    return "".join(
+        bars[min(len(bars) - 1,
+                 int((v - lo) / (hi - lo) * (len(bars) - 1)))]
+        for v in vals)
+
+
+def _print_timeseries_digest(window_s: float = 120.0):
+    """Compact flight-recorder digest for `ray_tpu status` (r19): the
+    recent window of a few load-bearing series as sparklines + last
+    value. Quiet when the recorder is empty or the head predates it."""
+    from ray_tpu import state as state_api
+
+    try:
+        hist = state_api.metrics_history(
+            names=["head.loop_lag_ms", "collective.*", "object_plane.*",
+                   "tasks.", "node."],
+            window_s=window_s)
+    except Exception:  # noqa: BLE001 — pre-r19 head
+        return
+    series = hist.get("series") or {}
+    rows = [(k, s["points"]) for k, s in sorted(series.items())
+            if s.get("points")]
+    if not rows:
+        return
+    print(f"metrics (last {window_s:g}s, "
+          f"{hist.get('sample_s', 0):g}s samples):")
+    for key, pts in rows[:12]:
+        print(f"  {key:<44} {_spark(pts)}  {pts[-1][1]:.3g}")
+    if len(rows) > 12:
+        print(f"  ... {len(rows) - 12} more series "
+              f"(state.metrics_history() / /api/timeseries)")
 
 
 def cmd_profile(args):
@@ -224,6 +267,66 @@ def cmd_doctor(args):
     return 0
 
 
+def cmd_timeline(args):
+    """Export the cluster timeline as chrome-trace JSON (ref: `ray
+    timeline`); ``--metrics`` additionally dumps the flight-recorder
+    series next to it so counter movement correlates with the trace."""
+    from ray_tpu import tracing
+
+    _attached(args)
+    events = tracing.timeline(args.out)
+    print(f"wrote {len(events)} trace events to {args.out} "
+          f"(open in chrome://tracing or ui.perfetto.dev)")
+    if args.metrics:
+        mout = args.metrics_out or (
+            args.out.rsplit(".", 1)[0] + ".metrics.json")
+        record = tracing.dump_flight_record(mout)
+        print(f"wrote {len(record.get('series', {}))} metric series "
+              f"to {mout}")
+    return 0
+
+
+def cmd_analyze(args):
+    """Comm-aware trace analysis (r19): utilization, exposed-comm,
+    pipeline bubbles and the critical path, rendered as text (or raw
+    JSON with --json)."""
+    from ray_tpu import tracing
+
+    _attached(args)
+    report = tracing.analyze()
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+        return 0
+    t = report["total"]
+    print(f"wall: {report['wall_s']:.3f}s   "
+          f"utilization: {t['utilization']:.1%}")
+    print(f"compute: {t['compute_s']:.3f}s   comm: {t['comm_s']:.3f}s   "
+          f"exposed-comm: {t['exposed_comm_s']:.3f}s "
+          f"({t['exposed_comm_frac']:.1%} of comm)")
+    if report["lanes"]:
+        print("lanes:")
+        for lane, row in sorted(report["lanes"].items()):
+            print(f"  {lane:<32} busy {row['busy_s']:7.3f}s "
+                  f"({row['utilization']:6.1%})  "
+                  f"comm {row['comm_s']:7.3f}s  "
+                  f"exposed {row['exposed_comm_s']:7.3f}s")
+    if report["stages"]:
+        print("pipeline stages:")
+        for key, st in sorted(report["stages"].items()):
+            print(f"  {key:<12} fwd {st['fwd_s']:7.3f}s  "
+                  f"bwd {st['bwd_s']:7.3f}s  ar {st['ar_s']:7.3f}s  "
+                  f"bubble {st['bubble_s']:7.3f}s "
+                  f"({st['bubble_frac']:.1%})")
+    crit = report["critical_path"]
+    if crit:
+        print(f"critical path ({report['critical_path_s']:.3f}s, "
+              f"{len(crit)} links):")
+        for link in crit[-args.path_limit:]:
+            print(f"  {link['start_s']:8.3f}s  {link['name']:<40} "
+                  f"{link['dur_s']:7.3f}s  [{link['lane']}]")
+    return 0
+
+
 def cmd_list(args):
     from ray_tpu import state as state_api
 
@@ -309,6 +412,27 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("entity", choices=["tasks", "actors", "objects"])
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("timeline",
+                        help="export chrome-trace JSON (+ flight-"
+                             "recorder metrics with --metrics)")
+    sp.add_argument("--out", default="timeline.json")
+    sp.add_argument("--metrics", action="store_true",
+                    help="also dump state.metrics_history() to JSON")
+    sp.add_argument("--metrics-out", default="",
+                    help="metrics dump path (default: <out>.metrics.json)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("analyze",
+                        help="comm-aware trace analysis: utilization, "
+                             "exposed-comm, bubbles, critical path")
+    sp.add_argument("--json", action="store_true",
+                    help="print the raw analyze() dict")
+    sp.add_argument("--path-limit", type=int, default=12,
+                    help="critical-path links to print")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_analyze)
 
     sp = sub.add_parser(
         "profile",

@@ -170,6 +170,30 @@ def phase_summary(funcs: Optional[Sequence[str]] = None
     return rows[0] if rows else {}
 
 
+def metrics_history(names: Optional[Sequence[str]] = None,
+                    window_s: Optional[float] = None) -> Dict[str, Any]:
+    """Flight-recorder readback (r19): bounded time series the head
+    sampled from its merged metric table every ``timeseries_sample_s``
+    seconds — counters folded to per-second rates, gauges as-is,
+    histograms as ``.p50/.p95/.p99`` point-estimate series. Returns
+    ``{sample_s, window_s, samples_taken, series: {key: {kind, points:
+    [[ts, v], ...], coarse: [[ts, v], ...]}}}`` where ``points`` is the
+    fine ring (most recent ``timeseries_window_s`` at sample
+    resolution) and ``coarse`` the 8:1 downsampled older tail. Series
+    keys are ``name`` or ``name{tag=v,...}``. ``names`` entries may be
+    exact keys, metric-name prefixes, or fnmatch globs
+    (``["head.loop_lag_ms", "collective.*"]``); ``window_s`` trims the
+    fine points to the trailing window. The reference gets this from an
+    external Prometheus/Grafana pair scraping the dashboard agent; here
+    the recent history is answerable by the head itself."""
+    kind = "metrics_history"
+    if names or window_s is not None:
+        win = "" if window_s is None else repr(float(window_s))
+        kind += f":{win}:" + ",".join(names or ())
+    rows = _query(kind, 1)
+    return rows[0] if rows else {}
+
+
 def pipeline_stage_summary(prefix: Optional[str] = None
                            ) -> Dict[int, Dict[str, Any]]:
     """Per-pipeline-stage bubble/transfer/compute split (r15), derived

@@ -31,6 +31,16 @@ from .core.context import get_context
 SPAN_START = "SPAN_START"
 SPAN_END = "SPAN_END"
 
+# Spans whose name starts with this land in timeline() under cat
+# "comm" — the communication lanes (collective hops, object-plane
+# transfers, pipeline grad all-reduce) the trace analyzer separates
+# from compute when computing exposed-comm time.
+COMM_PREFIX = "comm."
+
+# Page size for the chunked task-event pull (r19): bounds the head's
+# per-reply frame, replacing the old single 1M-row STATE_QUERY.
+_PAGE_LIMIT = 50_000
+
 
 def current_span_context() -> Optional[tuple]:
     """The active (trace_id, span_id) of this thread, if any — the task
@@ -66,12 +76,82 @@ def span(name: str):
                           span_id=span_id, parent_span_id=parent_id)
 
 
+@contextmanager
+def comm_span(name: str):
+    """``span()`` for communication intervals: prefixes the name with
+    ``comm.`` (so timeline() categorizes it as a comm lane event) and
+    NO-OPS outside a CoreContext — collective/transfer internals call
+    this from processes (node agents, teardown paths) that may not be
+    attached to a cluster, and instrumentation must never be the thing
+    that throws."""
+    from .core.context import get_context_if_exists
+
+    if get_context_if_exists() is None:
+        yield
+        return
+    with span(COMM_PREFIX + name if not name.startswith(COMM_PREFIX)
+              else name):
+        yield
+
+
+def record_comm_span(name: str, start_ts: float, end_ts: float,
+                     start_mono: Optional[float] = None,
+                     end_mono: Optional[float] = None):
+    """Retroactively emit one comm.* SPAN_START/SPAN_END pair for an
+    interval measured elsewhere (object-plane pulls stamp spans at
+    completion so the fetch path carries zero tracing overhead when the
+    transfer is small). No-op outside a CoreContext."""
+    from .core.context import get_context_if_exists
+
+    ctx = get_context_if_exists()
+    if ctx is None:
+        return
+    if not name.startswith(COMM_PREFIX):
+        name = COMM_PREFIX + name
+    parent = _ev.current_trace()
+    trace_id = parent[0] if parent else _random_bytes(16).hex()
+    parent_id = parent[1] if parent else ""
+    span_id = _ev.new_span_id()
+    ctx.events.record(span_id, name, SPAN_START, trace_id=trace_id,
+                      span_id=span_id, parent_span_id=parent_id,
+                      ts=start_ts, mono=start_mono)
+    ctx.events.record(span_id, name, SPAN_END, trace_id=trace_id,
+                      span_id=span_id, parent_span_id=parent_id,
+                      ts=end_ts, mono=end_mono)
+
+
+def _pull_task_events(ctx) -> List[dict]:
+    """Chunked raw-event readback (r19): page through the head's ring
+    via task_events_page so no single reply frame carries the whole
+    log. Falls back to the unpaged query against a pre-r19 head."""
+    rows: List[dict] = []
+    cursor = 0
+    while True:
+        try:
+            (reply,) = ctx.head.call(
+                P.STATE_QUERY, f"task_events_page:{cursor}",
+                _PAGE_LIMIT, timeout=30)
+            page = reply[0]
+        except Exception:  # noqa: BLE001 — pre-r19 head: unpaged pull
+            (rows,) = ctx.head.call(P.STATE_QUERY, "task_events",
+                                    1_000_000, timeout=30)
+            return rows
+        rows.extend(page["rows"])
+        cursor = page["next"]
+        if page["done"] or not page["rows"]:
+            return rows
+
+
 def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
     """Cluster timeline as chrome-trace events (ref: ray.timeline()).
 
     Task RUNNING->FINISHED/FAILED pairs and span START->END pairs become
     complete ("X") events; pid = node, tid = worker; args carry the
-    trace/span ids for traced events. Every task with lifecycle stamps
+    trace/span ids for traced events. Spans named ``comm.*`` (collective
+    hops, object-plane transfers, pipeline grad all-reduce — r19) get
+    cat "comm" so communication intervals lay in the same lanes as the
+    compute that should be hiding them (``analyze()`` computes the
+    exposed remainder). Every task with lifecycle stamps
     additionally gets per-phase sub-slices (cat "phase": sched_wait /
     dispatch / arg_fetch / exec / result_return) laid in the lane of the
     process that ended the phase — the "where does task time go" view,
@@ -83,8 +163,7 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
     # except for OTHER workers' buffers, which flush on their own 1s
     # period as in the reference).
     ctx.events.flush(sync=True)
-    (rows,) = ctx.head.call(P.STATE_QUERY, "task_events", 1_000_000,
-                            timeout=30)
+    rows = _pull_task_events(ctx)
     open_at: Dict[str, dict] = {}
     events: List[Dict[str, Any]] = []
     # per-task first-occurrence of each lifecycle state, for sub-slices
@@ -106,9 +185,14 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
                 args["trace_id"] = start["trace_id"]
                 args["span_id"] = start["span_id"]
                 args["parent_span_id"] = start["parent_span_id"]
+            if state == SPAN_END:
+                cat = "comm" if r["name"].startswith(COMM_PREFIX) \
+                    else "span"
+            else:
+                cat = "task"
             events.append({
                 "name": r["name"],
-                "cat": "span" if state == SPAN_END else "task",
+                "cat": cat,
                 "ph": "X",
                 "ts": start["ts"] * 1e6,           # chrome wants usec
                 "dur": max(r["ts"] - start["ts"], 0) * 1e6,
@@ -145,3 +229,39 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
         with open(filename, "w") as f:
             json.dump(events, f)
     return events
+
+
+def analyze(events: Optional[List[Dict[str, Any]]] = None,
+            filename: Optional[str] = None) -> Dict[str, Any]:
+    """Comm-aware trace analysis (r19): per-lane utilization,
+    exposed-comm time (communication not hidden under compute), per-
+    (stage, replica) bubble breakdown and the critical path — computed
+    from ``timeline()`` events (pulled fresh when ``events`` is None).
+    See :mod:`ray_tpu.trace_analysis` for the full result shape; the
+    ``ray_tpu analyze`` CLI renders it."""
+    from . import trace_analysis
+
+    if events is None:
+        events = timeline()
+    report = trace_analysis.analyze(events)
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(report, f, indent=2)
+    return report
+
+
+def dump_flight_record(filename: Optional[str] = None,
+                       names: Optional[List[str]] = None,
+                       window_s: Optional[float] = None) -> Dict[str, Any]:
+    """Flight-recorder snapshot (r19): the head's bounded metric time
+    series (``state.metrics_history``), optionally written to JSON so a
+    bench can correlate wall-clock trace events with counter movement
+    post-hoc (series points are wall-clock stamped, same timebase as
+    ``timeline()``'s ``ts``)."""
+    from . import state
+
+    record = state.metrics_history(names, window_s)
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(record, f)
+    return record

@@ -204,20 +204,27 @@ class _StageWorker:
         if local is not None:
             import jax
 
+            from ray_tpu import tracing
+
             leaves, treedef = jax.tree_util.tree_flatten(local)
             arrs = [np.asarray(leaf) for leaf in leaves]
-            for idxs in _grad_buckets(arrs, bucket_bytes):
-                flat = (arrs[idxs[0]].reshape(-1) if len(idxs) == 1
-                        else np.concatenate(
-                            [arrs[i].reshape(-1) for i in idxs]))
-                red = np.asarray(collective.allreduce(
-                    flat, group_name=self._dp_group, op="sum",
-                    transport=transport, timeout=timeout))
-                off = 0
-                for i in idxs:
-                    n = arrs[i].size
-                    arrs[i] = red[off:off + n].reshape(arrs[i].shape)
-                    off += n
+            # comm.ar.stage{k}r{rep}: the batch-end grad sync as one
+            # comm-lane interval (r19) — laid beside this replica's
+            # fwd/bwd compute so analyze() can report how much of a
+            # late stage's sync hid under early stages' backward waves
+            with tracing.comm_span(f"ar.stage{self.k}r{self.replica}"):
+                for idxs in _grad_buckets(arrs, bucket_bytes):
+                    flat = (arrs[idxs[0]].reshape(-1) if len(idxs) == 1
+                            else np.concatenate(
+                                [arrs[i].reshape(-1) for i in idxs]))
+                    red = np.asarray(collective.allreduce(
+                        flat, group_name=self._dp_group, op="sum",
+                        transport=transport, timeout=timeout))
+                    off = 0
+                    for i in idxs:
+                        n = arrs[i].size
+                        arrs[i] = red[off:off + n].reshape(arrs[i].shape)
+                        off += n
             synced = jax.tree_util.tree_unflatten(treedef, arrs)
             self._gsum_base = (synced if self._gsum_base is None
                                else _tree_add(self._gsum_base, synced))

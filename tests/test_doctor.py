@@ -56,7 +56,11 @@ def test_doctor_warns_on_event_drops(ray_start):
     from ray_tpu import dashboard as dash_mod
     from ray_tpu.core.api import _head
 
-    assert dash_mod.doctor_warnings() == []
+    # /dev/shm is machine-global: an earlier chaos test's hard-killed
+    # agent (or an unrelated session) may legitimately have orphaned
+    # rtpu_* arenas — that warning is not this test's subject
+    assert [w for w in dash_mod.doctor_warnings()
+            if "orphaned arena" not in w] == []
     maxlen = _head.cluster_events.maxlen
     for n in range(maxlen + 3):
         _head.emit_event("INFO", "test", "filler", f"event {n}")

@@ -1,0 +1,266 @@
+"""Comm-aware trace analyzer (r19): interval-math units, hand-built
+oracle traces with known exposed-comm / utilization / bubble /
+critical-path answers, comm spans riding ``timeline()`` for a real
+cross-node collective, and the acceptance gate — a DP pipeline's
+late-stage grad all-reduce overlapping early-stage backward compute
+(overlap fraction > 0).
+"""
+
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import tracing
+from ray_tpu import trace_analysis as ta
+from ray_tpu.train import pipeline as pl
+
+
+def _ev(name, cat, start_s, dur_s, pid=0, tid=0):
+    return {"name": name, "cat": cat, "ph": "X",
+            "ts": start_s * 1e6, "dur": dur_s * 1e6,
+            "pid": pid, "tid": tid}
+
+
+# ======================================================= interval math
+
+
+class TestIntervalMath:
+    def test_merge_coalesces_and_drops_empty(self):
+        merged = ta.merge_intervals(
+            [(1.0, 3.0), (0.0, 2.0), (5.0, 6.0), (6.0, 7.0), (9.0, 9.0)])
+        assert merged == [(0.0, 3.0), (5.0, 7.0)]
+        assert ta.total_len(merged) == 5.0
+
+    def test_overlap_len_against_merged_union(self):
+        merged = [(0.0, 3.0), (5.0, 7.0)]
+        assert ta.overlap_len(2.0, 6.0, merged) == 2.0  # [2,3) + [5,6)
+        assert ta.overlap_len(3.0, 5.0, merged) == 0.0
+        assert ta.overlap_len(-1.0, 10.0, merged) == 5.0
+
+
+# ================================================= hand-built oracles
+
+
+class TestAnalyzeOracle:
+    def test_exposed_comm_and_utilization(self):
+        """Lane 0/1 computes [0,10); lane 0/2 has one comm span fully
+        hidden under that compute and one fully exposed after it."""
+        events = [
+            _ev("stage0.fwd", "task", 0, 10, pid=0, tid=1),
+            _ev("comm.pull.2src", "comm", 4, 4, pid=0, tid=2),
+            _ev("comm.pull.2src", "comm", 10, 4, pid=0, tid=2),
+        ]
+        res = ta.analyze(events)
+        assert res["wall_s"] == 14.0
+        assert res["total"]["compute_s"] == 10.0
+        assert res["total"]["comm_s"] == 8.0
+        assert res["total"]["exposed_comm_s"] == 4.0
+        assert res["total"]["exposed_comm_frac"] == 0.5
+        hidden, exposed = res["comm_spans"]
+        assert hidden["overlap_frac"] == 1.0 and hidden["exposed_s"] == 0
+        assert exposed["overlap_frac"] == 0.0 and exposed["exposed_s"] == 4
+        lanes = res["lanes"]
+        assert lanes["0/1"]["utilization"] == 10.0 / 14.0
+        assert lanes["0/1"]["comm_s"] == 0.0
+        # lane-LOCAL exposure: lane 0/2 has no compute of its own, so
+        # all 8s of its comm are exposed from its point of view even
+        # though half is hidden cluster-wide
+        assert lanes["0/2"]["exposed_comm_s"] == 8.0
+        # mean-lane utilization: (10 + 8) / (2 * 14)
+        assert abs(res["total"]["utilization"] - 18.0 / 28.0) < 1e-12
+
+    def test_stage_bubbles_and_ar_attribution(self):
+        events = [
+            _ev("dp_stage0r0.fwd", "task", 0, 2, pid=0, tid=1),
+            _ev("dp_stage0r0.bwd", "task", 4, 2, pid=0, tid=1),
+            _ev("comm.ar.stage0r0", "comm", 6, 1, pid=0, tid=1),
+        ]
+        st = ta.analyze(events)["stages"]["stage0r0"]
+        assert st["fwd_s"] == 2.0 and st["bwd_s"] == 2.0
+        assert st["ar_s"] == 1.0          # the AR extends the window
+        assert st["window_s"] == 7.0
+        assert st["bubble_s"] == 2.0      # the [2,4) gap
+        assert abs(st["bubble_frac"] - 2.0 / 7.0) < 1e-12
+
+    def test_unreplicated_stage_names_default_replica_zero(self):
+        res = ta.analyze([_ev("stage2.fwd", "task", 0, 1)])
+        assert set(res["stages"]) == {"stage2r0"}
+
+    def test_critical_path_backward_walk(self):
+        events = [
+            _ev("a", "task", 0, 5, tid=1),
+            _ev("c", "task", 2, 2, tid=2),  # ends early: not on path
+            _ev("b", "comm", 5, 2, tid=3),
+            _ev("d", "task", 7, 1, tid=1),
+        ]
+        res = ta.analyze(events)
+        assert [r["name"] for r in res["critical_path"]] == \
+            ["a", "b", "d"]
+        assert res["critical_path_s"] == 8.0
+        assert res["critical_path"][0]["start_s"] == 0.0
+        assert res["critical_path"][-1]["end_s"] == 8.0
+
+    def test_span_and_phase_events_excluded_from_busy(self):
+        """User annotations overlay task intervals and phase sub-slices
+        shadow them — neither may count toward busy/wall time."""
+        events = [_ev("t", "task", 0, 2),
+                  _ev("anno", "span", 0, 4),
+                  _ev("exec", "phase", 0, 4)]
+        res = ta.analyze(events)
+        assert res["wall_s"] == 2.0
+        assert res["total"]["comm_s"] == 0.0
+        assert res["total"]["utilization"] == 1.0
+
+    def test_empty_trace(self):
+        res = ta.analyze([])
+        assert res["wall_s"] == 0.0 and res["critical_path"] == []
+        assert res["total"]["exposed_comm_frac"] == 0.0
+
+
+# ==================================== comm spans from a real collective
+
+
+class _CommMember:
+    def __init__(self, rank):
+        self.rank = rank
+
+    def init_collective(self, world, rank, group_name):
+        from ray_tpu import collective
+
+        collective.init_collective_group(world, rank,
+                                         group_name=group_name)
+        return True
+
+    def do_ar(self, group_name):
+        from ray_tpu import collective
+
+        out = collective.allreduce(
+            np.full(4096, self.rank + 1.0, np.float32),
+            group_name=group_name, transport="ring", timeout=60)
+        return float(out[0])
+
+
+def test_timeline_carries_collective_comm_spans(ray_start_cluster):
+    """A ring allreduce between ranks on two nodes must land comm.*
+    spans (per-hop + whole-op) in timeline(), cat "comm", beside the
+    task events — the lanes analyze() feeds on."""
+    from ray_tpu import collective
+    from ray_tpu.core.api import NodeAffinitySchedulingStrategy
+
+    cluster = ray_start_cluster
+    idx = cluster.add_node(num_cpus=2)
+    cls = ray_tpu.remote(_CommMember)
+    members = [
+        cls.options(num_cpus=1,
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node)).remote(r)
+        for r, node in enumerate((0, idx))]
+    collective.create_collective_group(
+        members, 2, [0, 1], group_name="gcomm")
+    outs = ray_tpu.get([m.do_ar.remote("gcomm") for m in members],
+                       timeout=120)
+    assert outs == [3.0, 3.0]
+    deadline = time.monotonic() + 30
+    comm = []
+    while time.monotonic() < deadline:
+        events = tracing.timeline()
+        comm = [e for e in events if e.get("cat") == "comm"]
+        if any(e["name"] == "comm.allreduce.ring" for e in comm):
+            break
+        time.sleep(0.5)  # worker event buffers flush on a 1s period
+    names = {e["name"] for e in comm}
+    assert "comm.allreduce.ring" in names, names
+    # per-hop sub-spans rode along (world 2 -> at least hop 0)
+    assert any(n.startswith("comm.allreduce.ring.h") for n in names), \
+        names
+    for e in comm:
+        assert e["ph"] == "X" and e["dur"] >= 0, e
+    # analyze() folds them into the comm ledger
+    res = ta.analyze(events)
+    assert res["total"]["comm_s"] > 0.0
+    assert any(sp["name"] == "comm.allreduce.ring"
+               for sp in res["comm_spans"])
+    for m in members:
+        ray_tpu.kill(m)
+
+
+# =============================================== the acceptance gate
+
+
+def _paced_raw_stages(n_stages, fwd_s, bwd0_s, bwd_s):
+    """Raw-mode stages (the documented way benchmarks pace compute with
+    sleeps — jax-mode sleeps only pace the vjp TRACE, i.e. forward).
+    Every stage carries real params and returns real dparams so
+    allreduce_grads has buckets to sync; stage 0's backward is
+    deliberately the slowest, so it falls ~(bwd0_s - bwd_s) further
+    behind per microbatch and is still draining backward waves when the
+    last stage's batch-end AR fires."""
+    stages = []
+    for k in range(n_stages):
+        params = np.full(1 << 14, float(k + 1), np.float32)
+        b = bwd0_s if k == 0 else bwd_s
+
+        def fwd(p, x, _s=fwd_s):
+            time.sleep(_s)
+            return x, None
+
+        def bwd(p, saved, g, _s=b):
+            time.sleep(_s)
+            return np.ones_like(p), (g if g is not None else 1.0)
+
+        stages.append(pl.PipelineStage(params=params, fwd=fwd, bwd=bwd))
+    return stages
+
+
+def test_dp_pipeline_ar_overlaps_early_stage_bwd(ray_start_cluster):
+    """The r19 acceptance gate: in a (2 stages x 2 replicas) pipeline,
+    the last stage's batch-end grad all-reduce is sequenced only behind
+    its OWN lane's final backward, so it runs while stage 0 is still
+    draining backward waves — analyze() must report comm.ar.stage1r*
+    spans with overlap_frac > 0 against the cluster-wide compute union,
+    and the raw events must show that overlap against stage-0 bwd
+    intervals specifically."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    stages = _paced_raw_stages(2, fwd_s=0.05, bwd0_s=0.4, bwd_s=0.1)
+    mbs = [np.full(4, float(i), np.float32) for i in range(8)]
+    pipe = pl.Pipeline(stages, schedule="1f1b",
+                       replicas_per_stage=2, name_prefix="ov_",
+                       max_inflight_microbatches=4)
+    pipe.run_batch(mbs, by_ref_min_bytes=0)
+    deadline = time.monotonic() + 30
+    ar_spans, events = [], []
+    while time.monotonic() < deadline:
+        events = tracing.timeline()
+        ar_spans = [e for e in events if e.get("cat") == "comm"
+                    and e["name"].startswith("comm.ar.stage1r")]
+        if len(ar_spans) >= 2:  # both replicas' final-stage AR
+            break
+        time.sleep(0.5)  # worker event buffers flush on a 1s period
+    assert len(ar_spans) >= 2, \
+        [e["name"] for e in events if e.get("cat") == "comm"]
+    res = tracing.analyze(events=events)
+    late = [sp for sp in res["comm_spans"]
+            if sp["name"].startswith("comm.ar.stage1r")]
+    assert late and max(sp["overlap_frac"] for sp in late) > 0.0, late
+    # the overlap is specifically against stage-0 backward compute
+    bwd0 = ta.merge_intervals([
+        (e["ts"] / 1e6, (e["ts"] + e["dur"]) / 1e6)
+        for e in events if e.get("cat") == "task"
+        and e["name"].startswith("ov_stage0") and
+        e["name"].endswith(".bwd")])
+    assert bwd0, "stage-0 bwd task events missing from the timeline"
+    covered = sum(
+        ta.overlap_len(e["ts"] / 1e6, (e["ts"] + e["dur"]) / 1e6, bwd0)
+        for e in ar_spans)
+    assert covered > 0.0, (ar_spans, bwd0)
+    # the per-(stage, replica) breakdown saw all four lanes and booked
+    # their all-reduce time
+    for key in ("stage0r0", "stage0r1", "stage1r0", "stage1r1"):
+        assert key in res["stages"], res["stages"].keys()
+        assert res["stages"][key]["bwd_s"] > 0.0
+    assert sum(res["stages"][k]["ar_s"]
+               for k in ("stage1r0", "stage1r1")) > 0.0
+    pipe.shutdown()
