@@ -97,6 +97,83 @@ class _LoopLag:
         }
 
 
+class _ArenaProbe:
+    """Arena accounting during a phase (memory observatory, r20): a
+    background sampler polls ``state.memory_summary()`` and keeps the
+    per-node arena peaks (used bytes + the store's own highwater from
+    the heartbeat) and the per-job peak resident-byte split. The time
+    spent inside the summary calls is the accounting overhead; the gate
+    bounds it at 2% of phase wall — observability that distorts the
+    phase it observes would be worse than none."""
+
+    def __init__(self, period_s: float = 1.0):
+        # 1s period: the arena heartbeat itself only updates every
+        # node_telemetry_period_s (2s), and a summary call costs ~14ms
+        # on a busy directory — sampling at 0.25s measured 5% of wall,
+        # violating the <=2% gate this block exists to enforce
+        self.period_s = period_s
+        self.node_peak = {}
+        self.job_peak = {}
+        self.samples = 0
+        self.spent_s = 0.0
+        self._stop = threading.Event()
+        self._t0 = 0.0
+        self._thread = None
+
+    def _sample(self):
+        from ray_tpu import state
+
+        while not self._stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                s = state.memory_summary()
+            except Exception:  # noqa: BLE001 — cluster tearing down
+                break
+            self.spent_s += time.perf_counter() - t0
+            self.samples += 1
+            for idx, row in (s.get("nodes") or {}).items():
+                arena = row.get("arena") or {}
+                p = self.node_peak.setdefault(
+                    str(idx), {"used_bytes": 0, "highwater_bytes": 0,
+                               "resident_bytes": 0})
+                p["used_bytes"] = max(
+                    p["used_bytes"], int(arena.get("used_bytes", 0)))
+                p["highwater_bytes"] = max(
+                    p["highwater_bytes"],
+                    int(arena.get("highwater_bytes", 0)))
+                p["resident_bytes"] = max(
+                    p["resident_bytes"],
+                    int(row.get("resident_bytes", 0)))
+            for job, row in (s.get("jobs") or {}).items():
+                self.job_peak[job or "(none)"] = max(
+                    self.job_peak.get(job or "(none)", 0),
+                    int(row.get("resident_bytes", 0)))
+            self._stop.wait(self.period_s)
+
+    def start(self):
+        self._t0 = time.perf_counter()
+        self._thread = threading.Thread(target=self._sample, daemon=True)
+        self._thread.start()
+        return self
+
+    def block(self) -> dict:
+        wall = time.perf_counter() - self._t0
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        frac = self.spent_s / wall if wall > 0 else 0.0
+        return {
+            "node_peaks": self.node_peak,
+            "job_peak_resident_bytes": self.job_peak,
+            "samples": self.samples,
+            "sample_period_s": self.period_s,
+            "phase_wall_s": round(wall, 3),
+            "accounting_overhead_s": round(self.spent_s, 4),
+            "overhead_frac_of_wall": round(frac, 5),
+            "gate_overhead_le_2pct": frac <= 0.02,
+        }
+
+
 def _start_cluster():
     from ray_tpu.cluster_utils import Cluster
 
@@ -164,6 +241,7 @@ def bench_shuffle(pairs: int) -> dict:
         # warm: worker spawn + function export + first-touch paths
         _set_mode(False)
         _run_shuffle(0)
+        arena = _ArenaProbe().start()
         for i in range(pairs):
             _set_mode(True)
             t0 = time.perf_counter()
@@ -185,6 +263,7 @@ def bench_shuffle(pairs: int) -> dict:
                   f"pipe {pipe_wall:.2f}s "
                   f"ratio {pipe_wall / drain_wall:.3f}",
                   file=sys.stderr, flush=True)
+        arena_block = arena.block()
         lag_delta = lag.delta()
     finally:
         try:
@@ -194,6 +273,7 @@ def bench_shuffle(pairs: int) -> dict:
         cluster.shutdown()
     ratio = _median([r["ratio"] for r in rows])
     return {
+        "arena": arena_block,
         "blocks": N_BLOCKS, "block_mib": BLOCK_KIB / 1024,
         "n_out": N_OUT, "read_s_per_block": READ_S,
         "link_mib_s": LINK_MIB_S,

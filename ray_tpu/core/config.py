@@ -546,6 +546,33 @@ class Config:
     # <= 0 sample period disables the recorder entirely.
     timeseries_sample_s: float = 1.0
     timeseries_window_s: float = 300.0
+    # --- memory observatory (r20) ---
+    # Arena accounting rides the node-telemetry heartbeat above
+    # (`node_telemetry_period_s` is the sample cadence): each beat
+    # publishes the store's memory_stats() as object_plane.arena_*
+    # gauges, which flow into node rows, Prometheus, and the flight
+    # recorder. The knobs below tune the derived surfaces only — the
+    # accounting itself has no switch of its own (disable telemetry to
+    # disable it).
+    # Top-N largest-object cap for `ray_tpu memory` /
+    # `/api/summary/memory` (reference: ray memory's --num-entries).
+    memory_summary_top_n: int = 20
+    # doctor: warn when a node's arena_used_bytes grew monotonically
+    # (no sample below its predecessor) across the trailing
+    # `arena_growth_warn_window_s` seconds of flight-recorder history
+    # AND the total growth exceeds `arena_growth_warn_min_frac` of
+    # capacity — the signature of a reference leak, as opposed to
+    # steady-state churn which dips on every free.
+    arena_growth_warn_window_s: float = 120.0
+    arena_growth_warn_min_frac: float = 0.05
+    # doctor: warn when a node's arena fill (used/capacity) crosses
+    # this fraction — next allocation burst likely evicts or OOMs.
+    arena_pressure_warn_frac: float = 0.90
+    # doctor: warn when a borrow-ledger deferred delete has been stuck
+    # behind live zero-copy views for longer than this (a leaked view
+    # holds the arena slot forever); <= 0 disables the check.
+    borrow_deferred_delete_warn_s: float = 30.0
+
     # Object-plane transfers (pull/push/prefetch) below this byte size
     # do NOT emit comm.* timeline spans; tiny control-sized objects
     # would otherwise flood the task-event ring with microsecond spans

@@ -496,6 +496,18 @@ class CoreContext:
     def current_task_id(self, tid):
         self._task_tls.task_id = tid
 
+    @property
+    def current_job_id(self):
+        """The job whose code is running on THIS thread: the executing
+        task's spec.job_id inside a task/actor method, this context's
+        own job otherwise (driver puts). Seal reports stamp it onto
+        directory entries for per-job memory attribution."""
+        return getattr(self._task_tls, "job_id", None) or self.job_id
+
+    @current_job_id.setter
+    def current_job_id(self, jid):
+        self._task_tls.job_id = jid
+
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(self.current_task_id, next(self._put_index))
         sv = serialize(value)
@@ -512,11 +524,31 @@ class CoreContext:
             for r in sv.contained_refs:
                 self.ref_counter.mark_shared(r.id)
         total = self.store.put_serialized(oid, sv.frames)
+        # size on the wire is DATA bytes (sv.total_bytes): the whole
+        # transfer plane (stripe ranges, pull buffers, relay parts)
+        # keys on it; store-exact accounting compares against
+        # memory_stats()["sealed_data_bytes"], which counts the same
         self.head.send(P.OBJECT_SEALED, oid.binary(), self.node_idx,
-                       sv.total_bytes, self.worker_id)
+                       sv.total_bytes, self.worker_id,
+                       self.current_job_id.hex())
         self.memory_store.put_plasma_location(oid, self.node_idx,
                                               size=total)
         return ObjectRef(oid, self.worker_id)
+
+    def tag_objects(self, refs, tag: str):
+        """Stamp a reference-class tag (memory observatory) onto the
+        head directory entries behind ``refs`` — e.g. the pipeline
+        tags its held checkpoint refs "checkpoint" so `ray_tpu memory`
+        can split resident bytes by what is holding them. One-way and
+        advisory: unsealed/freed ids are ignored by the head."""
+        oid_bins = [(r.id if hasattr(r, "id") else r).binary()
+                    for r in refs]
+        if not oid_bins:
+            return
+        try:
+            self.head.send(P.OBJ_TAG, oid_bins, tag)
+        except P.ConnectionLost:
+            pass
 
     def _report_evictions_async(self, oids: Sequence[ObjectID]):
         """store.on_evict hook: report off-thread so the allocating thread
@@ -917,7 +949,8 @@ class CoreContext:
         except Exception:
             return
         self.head.send(P.OBJECT_SEALED, ref.id.binary(), self.node_idx,
-                       sv.total_bytes, self.worker_id)
+                       sv.total_bytes, self.worker_id,
+                       self.current_job_id.hex())
         e.in_plasma = True
         e.node_idx = self.node_idx
         e.plasma_size = sv.total_bytes
@@ -2065,6 +2098,7 @@ class CoreContext:
         if spec.task_id in self._cancelled:
             return (spec.task_id.binary(), "cancelled", None, None)
         self.current_task_id = spec.task_id
+        self.current_job_id = spec.job_id
         if spec.tpu_ids is not None:
             # Export the head-assigned chips before user code imports JAX
             # (the reference sets CUDA_VISIBLE_DEVICES the same way,
@@ -2183,7 +2217,8 @@ class CoreContext:
                 if not self.store.contains(oid):
                     self.store.put_serialized(oid, sv.frames)
                 self.head.send(P.OBJECT_SEALED, oid.binary(), self.node_idx,
-                               sv.total_bytes, spec.owner)
+                               sv.total_bytes, spec.owner,
+                               spec.job_id.hex())
                 meta.append(("p", self.node_idx))
         return meta
 

@@ -2514,10 +2514,11 @@ class Head:
 
     # ------------------------------------------------- object directory
 
-    def _h_object_sealed(self, conn, rid, oid_bin, node_idx, size, owner):
+    def _h_object_sealed(self, conn, rid, oid_bin, node_idx, size, owner,
+                         job_id_hex=""):
         oid = ObjectID(oid_bin)
         node_idx, size, waiters = self.objects.record_sealed(
-            oid, node_idx, size, owner)
+            oid, node_idx, size, owner, job_id_hex)
         for wconn, wrid in waiters:
             try:
                 wconn.reply(wrid, node_idx, size, "",
@@ -2525,6 +2526,13 @@ class Head:
             except P.ConnectionLost:
                 pass  # that waiter died; the rest must still hear
         self._maybe_spill(node_idx)
+
+    def _h_obj_tag(self, conn, rid, oid_bins, tag):
+        """Reference-class tag stamp (one-way; memory observatory)."""
+        self.objects.tag_objects([ObjectID(ob) for ob in oid_bins],
+                                 str(tag))
+        if rid > 0:
+            conn.reply(rid, True)
 
     def _directory_add(self, oid: ObjectID, node_idx: int, size: int = 0):
         """A node gained a copy (pull completion / replica creation)."""
@@ -3408,10 +3416,15 @@ class Head:
             for kind, name, desc, meta, tags_key, value in batch:
                 # reporter telemetry rows are identified by name prefix
                 # AND the reserved ("node",) tag-key shape, so user
-                # metrics that merely start with "node." are untouched
-                is_node_telemetry = (kind == "gauge"
-                                     and name.startswith("node.")
-                                     and tuple(meta) == ("node",))
+                # metrics that merely start with "node." are untouched.
+                # The arena memory-observatory gauges ride the same
+                # heartbeat with the same tag shape — they mirror into
+                # node rows too (and flow through the metric table into
+                # Prometheus + the flight recorder like any gauge).
+                is_node_telemetry = (
+                    kind == "gauge" and tuple(meta) == ("node",)
+                    and (name.startswith("node.")
+                         or name.startswith("object_plane.arena_")))
                 if is_node_telemetry:
                     # drop in-flight reports from nodes already removed
                     # — merging them would resurrect a dead host's
@@ -4134,6 +4147,137 @@ class Head:
                 _hist_quantile(hop_bounds, hop, 0.95), 6)
         return out
 
+    # telemetry gauge -> short key in the per-node "arena" block of the
+    # memory summary (reported by NodeTelemetryReporter off each store's
+    # memory_stats(); absent until the first heartbeat lands)
+    _ARENA_TELEMETRY_KEYS = {
+        "object_plane.arena_capacity_bytes": "capacity",
+        "object_plane.arena_used_bytes": "used_bytes",
+        "object_plane.arena_highwater_bytes": "highwater_bytes",
+        "object_plane.arena_entries": "entries",
+        "object_plane.arena_sealed_bytes": "sealed_bytes",
+        "object_plane.arena_sealed_data_bytes": "sealed_data_bytes",
+        "object_plane.arena_unsealed_bytes": "unsealed_bytes",
+        "object_plane.arena_pinned_bytes": "pinned_bytes",
+        "object_plane.arena_borrow_pinned_bytes": "borrow_pinned_bytes",
+        "object_plane.arena_deferred_deletes": "deferred_deletes",
+        "object_plane.arena_deferred_delete_oldest_s":
+            "deferred_delete_oldest_s",
+    }
+
+    def _sq_memory_summary(self, limit):
+        """Cluster memory rollup (memory observatory): per-node and
+        per-job/per-owner resident-byte aggregates off the object
+        directory, merged with each node's last arena heartbeat, plus
+        the reference-class breakdown and the top-N largest objects.
+        Reference: `ray memory` / memory_utils.py's grouped object
+        table, served from GCS object tables there, from the sharded
+        directory here. Per-node resident bytes count every COPY on
+        that node (so they compare exactly against the node store's
+        sealed payload bytes); job/owner/total aggregates do too —
+        they answer "whose bytes sit in arenas", not "how many
+        distinct values exist"."""
+        cfg = get_config()
+        top_n = max(1, min(int(cfg.memory_summary_top_n),
+                           limit if limit > 0 else 1 << 30))
+        now = time.time()
+        with self._lock:
+            live_owners = {w.worker_id
+                           for n in self.nodes.values()
+                           for w in n.workers.values()}
+            node_idxs = sorted(self.nodes)
+        with self._metrics_lock:
+            telemetry = {i: dict(t)
+                         for i, t in self.node_telemetry.items()}
+        nodes: Dict[int, dict] = {
+            i: {"resident_bytes": 0, "resident_objects": 0,
+                "spilled_bytes": 0, "arena": {}} for i in node_idxs}
+        jobs: Dict[str, dict] = {}
+        owners: Dict[str, dict] = {}
+        classes = {"sealed_bytes": 0, "spilled_bytes": 0,
+                   "checkpoint_bytes": 0, "prefetch_inflight_bytes": 0,
+                   "borrow_pinned_bytes": 0}
+        dead_owner = {"objects": 0, "bytes": 0, "owners": set()}
+        all_objs: List[dict] = []
+        for oid, loc in self.objects.items_snapshot():
+            with self.objects.lock_for(oid):
+                holders = sorted(loc.holders)
+                size, owner, job = loc.size, loc.owner, loc.job
+                tag, sealed_at = loc.tag, loc.sealed_at
+                spilled = bool(loc.spilled_path)
+                inprog = bool(loc.inprog)
+            copies = len(holders)
+            resident = size * copies
+            if not resident and not spilled:
+                continue
+            for h in holders:
+                row = nodes.setdefault(
+                    h, {"resident_bytes": 0, "resident_objects": 0,
+                        "spilled_bytes": 0, "arena": {}})
+                row["resident_bytes"] += size
+                row["resident_objects"] += 1
+            if spilled:
+                classes["spilled_bytes"] += size
+            classes["sealed_bytes"] += resident
+            if tag == "checkpoint":
+                classes["checkpoint_bytes"] += resident
+            if inprog:
+                classes["prefetch_inflight_bytes"] += size
+            jrow = jobs.setdefault(job or "", {
+                "resident_bytes": 0, "objects": 0, "per_node": {}})
+            jrow["resident_bytes"] += resident
+            jrow["objects"] += 1
+            for h in holders:
+                jrow["per_node"][h] = jrow["per_node"].get(h, 0) + size
+            orow = owners.setdefault(owner or "", {
+                "resident_bytes": 0, "objects": 0, "live": True})
+            orow["resident_bytes"] += resident
+            orow["objects"] += 1
+            if owner and owner not in live_owners:
+                orow["live"] = False
+                if resident:
+                    dead_owner["objects"] += 1
+                    dead_owner["bytes"] += resident
+                    dead_owner["owners"].add(owner)
+            all_objs.append({
+                "object_id": oid.hex(), "size": size,
+                "node_idx": holders[0] if holders else -1,
+                "holders": holders, "owner": owner, "job": job,
+                "tag": tag, "spilled": spilled,
+                "age_s": round(now - sealed_at, 3) if sealed_at
+                else 0.0,
+            })
+        for idx, row in nodes.items():
+            t = telemetry.get(idx, {})
+            row["arena"] = {
+                short: t[g] for g, short in
+                self._ARENA_TELEMETRY_KEYS.items() if g in t}
+            spilled_here = sum(
+                o["size"] for o in all_objs
+                if o["spilled"] and o["node_idx"] == idx)
+            row["spilled_bytes"] = spilled_here
+        classes["borrow_pinned_bytes"] = int(sum(
+            t.get("object_plane.arena_borrow_pinned_bytes", 0)
+            for t in telemetry.values()))
+        all_objs.sort(key=lambda o: o["size"], reverse=True)
+        dead_owner["owners"] = sorted(dead_owner["owners"])
+        return [{
+            "nodes": nodes,
+            "jobs": jobs,
+            "owners": owners,
+            "classes": classes,
+            "dead_owner": dead_owner,
+            "top_objects": all_objs[:top_n],
+            "totals": {
+                "resident_bytes": sum(
+                    n["resident_bytes"] for n in nodes.values()),
+                "resident_objects": len(
+                    [o for o in all_objs if o["holders"]]),
+                "spilled_bytes": classes["spilled_bytes"],
+                "prefetch_inflight": self._prefetch_inflight_count(),
+            },
+        }]
+
     def _sq_metrics(self, limit):
         # merged client metrics plus the head's own ring-buffer
         # health counters, so silent event drops surface in
@@ -4359,6 +4503,7 @@ class Head:
         "placement_groups": _sq_placement_groups,
         "objects": _sq_objects,
         "object_plane": _sq_object_plane,
+        "memory_summary": _sq_memory_summary,
         "metrics": _sq_metrics,
         "io_loop": _sq_io_loop,
         "cluster_events": _sq_cluster_events,
@@ -4521,6 +4666,7 @@ class Head:
         P.SUBSCRIBE: _h_subscribe,
         P.PUBLISH: _h_publish,
         P.OBJECT_SEALED: _h_object_sealed,
+        P.OBJ_TAG: _h_obj_tag,
         P.OBJECT_LOCATE: _h_object_locate,
         P.OBJECT_FREE: _h_object_free,
         P.OBJ_LOCATION_ADD: _h_obj_location_add,

@@ -32,6 +32,7 @@ the old lock-dropping paths already had.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
@@ -55,6 +56,14 @@ class _ObjLoc:
     node_idx: int = -1
     size: int = 0
     owner: str = ""
+    # memory-observatory attribution (stamped by record_sealed): the
+    # sealing context's job id (hex), the wall-clock seal time (object
+    # age in `ray_tpu memory` / list objects), and an optional reference
+    # class tag ("checkpoint" — pipeline checkpoint refs — today;
+    # empty = plain sealed object).
+    job: str = ""
+    sealed_at: float = 0.0
+    tag: str = ""
     spilled_path: str = ""
     holders: Set[int] = field(default_factory=set)
     waiters: List[Waiter] = field(default_factory=list)
@@ -155,6 +164,7 @@ class ObjectDirectory:
         after the snapshot lock is released can race a concurrent
         holder-add and raise mid-query."""
         rows: List[dict] = []
+        now = time.time()
         for shard, lock in zip(self._shards, self._locks):
             with lock:
                 for oid, loc in shard.items():
@@ -164,6 +174,9 @@ class ObjectDirectory:
                         "object_id": oid.hex(),
                         "node_idx": loc.node_idx,
                         "size": loc.size, "owner": loc.owner,
+                        "job": loc.job, "tag": loc.tag,
+                        "age_s": round(now - loc.sealed_at, 3)
+                        if loc.sealed_at else 0.0,
                         "spilled": bool(loc.spilled_path),
                         "holders": sorted(loc.holders),
                     })
@@ -213,7 +226,8 @@ class ObjectDirectory:
     # ------------------------------------------------- directory operations
 
     def record_sealed(self, oid: ObjectID, node_idx: int, size: int,
-                      owner: str) -> Tuple[int, int, List[Waiter]]:
+                      owner: str, job: str = ""
+                      ) -> Tuple[int, int, List[Waiter]]:
         """OBJECT_SEALED bookkeeping; returns (node_idx, size, waiters
         to answer with the location)."""
         self.clear_lost(oid)  # a recovered object is found again
@@ -222,10 +236,23 @@ class ObjectDirectory:
             loc.node_idx = node_idx
             loc.size = size
             loc.owner = owner
+            if job:
+                loc.job = job
+            loc.sealed_at = time.time()
             loc.holders.add(node_idx)
             waiters = list(loc.waiters)
             loc.waiters.clear()
             return node_idx, size, waiters
+
+    def tag_objects(self, oids: Iterable[ObjectID], tag: str):
+        """Stamp a reference-class tag (e.g. ``"checkpoint"``) onto
+        existing entries — the memory summary's class breakdown keys off
+        it. Unknown ids are ignored (the object may have been freed)."""
+        for oid in oids:
+            with self.lock_for(oid):
+                loc = self.get(oid)
+                if loc is not None:
+                    loc.tag = tag
 
     def add_location(self, oid: ObjectID, node_idx: int, size: int = 0
                      ) -> Tuple[int, int, List[Waiter]]:

@@ -72,6 +72,9 @@ def _load_lib():
     lib.shm_store_list.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
         ctypes.c_int]
+    lib.shm_store_memory_stats.restype = None
+    lib.shm_store_memory_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
     return lib
 
 
@@ -204,13 +207,19 @@ class _BorrowEntry:
     frame-view wrappers handed out by ``get_frames(pin_borrows=True)``,
     plus whether a delete arrived while they were alive."""
 
-    __slots__ = ("refs", "deferred_delete")
+    __slots__ = ("refs", "deferred_delete", "nbytes", "deferred_since")
 
     def __init__(self):
         # list, not set: weakrefs to ndarray views are unhashable
         # (ndarray defines array __eq__); removal is by identity
         self.refs: list = []
         self.deferred_delete = False
+        # accounting for memory_stats(): payload bytes the pinned views
+        # alias, and when a deferred delete started waiting (monotonic;
+        # 0.0 while none is pending) — the deferred-delete-pileup doctor
+        # warning ages entries off this stamp
+        self.nbytes = 0
+        self.deferred_since = 0.0
 
 
 class ShmObjectStore:
@@ -408,6 +417,7 @@ class ShmObjectStore:
                 if entry is not None:
                     if not entry.deferred_delete:
                         entry.deferred_delete = True
+                        entry.deferred_since = time.monotonic()
                         self.borrow_deferred_deletes += 1
                 else:
                     # no ledger entry: the failing pin may have been the
@@ -448,6 +458,53 @@ class ShmObjectStore:
         if self._closed:
             return 0
         return get_lib().shm_store_num_objects(self._h)
+
+    def memory_stats(self) -> Dict[str, int]:
+        """Arena accounting snapshot — one native call (single lock
+        acquisition + table scan) merged with the Python-side borrow
+        ledger, cheap enough for the node-telemetry heartbeat. Keys:
+        ``capacity`` / ``used_bytes`` (arena blocks incl. headers) /
+        ``highwater_bytes`` / ``entries`` / ``sealed_count`` /
+        ``sealed_bytes`` (data + frame-size metadata, the arena truth) /
+        ``sealed_data_bytes`` (data only — the wire/dir size
+        convention, exact vs. the directory's per-object sizes) /
+        ``unsealed_count`` / ``unsealed_bytes`` /
+        ``pinned_count`` / ``pinned_bytes`` (native reader pins) /
+        ``borrow_pinned_count`` / ``borrow_pinned_bytes`` (zero-copy
+        views alive in THIS process) / ``deferred_deletes`` (pending) /
+        ``deferred_delete_oldest_s`` (age of the oldest one)."""
+        if self._closed:
+            return {}
+        out = (ctypes.c_uint64 * 11)()
+        get_lib().shm_store_memory_stats(self._h, out)
+        borrow_count = borrow_bytes = 0
+        deferred = 0
+        oldest = 0.0
+        now = time.monotonic()
+        with self._borrow_lock:
+            for entry in self._borrows.values():
+                borrow_count += 1
+                borrow_bytes += entry.nbytes
+                if entry.deferred_delete:
+                    deferred += 1
+                    oldest = max(oldest, now - entry.deferred_since)
+        return {
+            "capacity": int(out[0]),
+            "used_bytes": int(out[1]),
+            "highwater_bytes": int(out[2]),
+            "entries": int(out[3]),
+            "sealed_count": int(out[4]),
+            "sealed_bytes": int(out[5]),
+            "sealed_data_bytes": int(out[10]),
+            "unsealed_count": int(out[6]),
+            "unsealed_bytes": int(out[7]),
+            "pinned_count": int(out[8]),
+            "pinned_bytes": int(out[9]),
+            "borrow_pinned_count": borrow_count,
+            "borrow_pinned_bytes": borrow_bytes,
+            "deferred_deletes": deferred,
+            "deferred_delete_oldest_s": oldest,
+        }
 
     def list_objects(self, max_objects: int = 8192
                      ) -> List[Tuple[ObjectID, int]]:
@@ -503,6 +560,16 @@ class ShmObjectStore:
         part.finish(sealed)
 
     # -- serialized-value interface ------------------------------------------
+
+    @staticmethod
+    def sealed_nbytes(frames: List) -> int:
+        """The exact payload bytes (data + metadata) put_serialized
+        would seal for these frames — what the native entry's
+        data_size + meta_size will read, and therefore what the head
+        directory must record for per-node byte attribution to agree
+        exactly with the store's own memory_stats()."""
+        sizes = [len(f) for f in frames]
+        return sum(sizes) + len(pickle.dumps(sizes, protocol=5))
 
     def put_serialized(self, object_id: ObjectID, frames: List) -> int:
         """Serialize-into-store put: reserve the shm object from a cheap
@@ -605,6 +672,8 @@ class ShmObjectStore:
             for v in views:
                 entry.refs.append(weakref.ref(
                     v, lambda r, oid=object_id: self._borrow_dead(oid, r)))
+            if fresh:
+                entry.nbytes = sum(len(v) for v in views)
         if fresh:
             # the ledger's own pin (independent of the caller's read
             # pin): bump the native refcount, drop the views

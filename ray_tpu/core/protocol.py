@@ -44,7 +44,10 @@ KV_DEL = 12
 KV_KEYS = 13
 SUBSCRIBE = 14          # (channel,)
 PUBLISH = 15            # (channel, payload)
-OBJECT_SEALED = 16      # (object_id_bin, node_idx, size, owner_hex)
+OBJECT_SEALED = 16      # (object_id_bin, node_idx, size, owner_hex
+#                         [, job_id_hex]) — the trailing job id (memory
+#                         observatory) is optional for wire compat with
+#                         pre-r20 senders; the handler defaults it to "".
 OBJECT_LOCATE = 17      # (object_id_bin)
 OBJECT_LOCATE_REPLY = 18  # (node_idx or -1, size, spilled_url)
 OBJECT_FREE = 19        # (object_id_bins,)
@@ -239,6 +242,12 @@ OBJECT_WARM = 79        # client->head: (oid_bin, node_idx) — warm an
 #                         form the r9 cooperative broadcast tree.
 #                         Replied (pull count issued) when sent as a
 #                         call; also valid one-way.
+OBJ_TAG = 81            # client->head, one-way: ([oid_bins], tag) —
+#                         stamp a reference-class tag onto directory
+#                         entries (memory observatory: "checkpoint" for
+#                         pipeline checkpoint refs). Purely advisory
+#                         accounting metadata: `ray_tpu memory`'s class
+#                         breakdown splits resident bytes by it.
 OBJ_PULL_FAIL = 72      # server->puller: (oid_bin, offset) — the server
                         # cannot complete the requested range past
                         # `offset` (its own in-progress pull aborted, or

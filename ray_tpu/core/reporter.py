@@ -106,6 +106,47 @@ class NodeTelemetryReporter:
         "node.disk_write_bytes": "Cumulative disk bytes written",
         "node.object_store_used_bytes": "Shm object store bytes in use",
         "node.object_store_capacity_bytes": "Shm object store capacity",
+        # arena memory-observatory gauges (store.memory_stats()): one
+        # native lock + table scan per sample, piggybacked on this same
+        # heartbeat — no extra channel. Flow into the head's metric
+        # table (Prometheus + flight-recorder timeseries) AND node rows.
+        "object_plane.arena_capacity_bytes": "Arena capacity (bytes)",
+        "object_plane.arena_used_bytes":
+            "Arena bytes in use (blocks incl. headers)",
+        "object_plane.arena_highwater_bytes":
+            "Max arena bytes in use ever observed",
+        "object_plane.arena_entries": "Live arena entries",
+        "object_plane.arena_sealed_bytes":
+            "Payload bytes of sealed objects",
+        "object_plane.arena_sealed_data_bytes":
+            "Sealed object data bytes only (the wire/directory size "
+            "convention — per-node directory sums match this exactly)",
+        "object_plane.arena_unsealed_bytes":
+            "Payload bytes of created-but-unsealed objects",
+        "object_plane.arena_pinned_bytes":
+            "Payload bytes pinned by native readers",
+        "object_plane.arena_borrow_pinned_bytes":
+            "Payload bytes pinned by live zero-copy borrow views",
+        "object_plane.arena_deferred_deletes":
+            "Deletes deferred behind live borrow views",
+        "object_plane.arena_deferred_delete_oldest_s":
+            "Age of the oldest pending deferred delete (seconds)",
+    }
+
+    # memory_stats() key -> gauge name (sample_and_publish)
+    _ARENA_GAUGES = {
+        "capacity": "object_plane.arena_capacity_bytes",
+        "used_bytes": "object_plane.arena_used_bytes",
+        "highwater_bytes": "object_plane.arena_highwater_bytes",
+        "entries": "object_plane.arena_entries",
+        "sealed_bytes": "object_plane.arena_sealed_bytes",
+        "sealed_data_bytes": "object_plane.arena_sealed_data_bytes",
+        "unsealed_bytes": "object_plane.arena_unsealed_bytes",
+        "pinned_bytes": "object_plane.arena_pinned_bytes",
+        "borrow_pinned_bytes": "object_plane.arena_borrow_pinned_bytes",
+        "deferred_deletes": "object_plane.arena_deferred_deletes",
+        "deferred_delete_oldest_s":
+            "object_plane.arena_deferred_delete_oldest_s",
     }
 
     def __init__(self, publish_fn: Callable[[list], None],
@@ -169,6 +210,10 @@ class NodeTelemetryReporter:
                         float(store.bytes_in_use())
                     vals["node.object_store_capacity_bytes"] = \
                         float(store.capacity())
+                    mem = store.memory_stats()
+                    for key, gname in self._ARENA_GAUGES.items():
+                        if key in mem:
+                            vals[gname] = float(mem[key])
                 except Exception:  # noqa: BLE001 — store closing
                     pass
             tags_key = (str(node_idx),)

@@ -123,7 +123,12 @@ class _Handler(BaseHTTPRequestHandler):
                       # pipelined-exchange counters (r17): cluster
                       # data.shuffle_* metric rows + the driver-local
                       # live SHUFFLE_STATS view
-                      "shuffle": state.data_shuffle_summary}.get(kind)
+                      "shuffle": state.data_shuffle_summary,
+                      # memory observatory (r20): per-node/-job/-owner
+                      # resident bytes, arena heartbeats, class
+                      # breakdown, top objects — `ray_tpu memory`'s
+                      # data, served over HTTP
+                      "memory": state.memory_summary}.get(kind)
                 if fn is None:
                     self._json({"error": f"unknown summary {kind}"}, 404)
                 else:
@@ -228,6 +233,7 @@ DOCTOR_ENDPOINTS = (
     "/api/metrics", "/api/jobs", "/api/timeline", "/api/timeseries",
     "/api/summary/tasks", "/api/summary/actors", "/api/summary/objects",
     "/api/summary/pipeline", "/api/summary/shuffle",
+    "/api/summary/memory",
     "/api/serve/applications",
     "/metrics",
 )
@@ -304,6 +310,121 @@ def orphan_arena_files(shm_dir: str = "/dev/shm") -> list:
         except OSError:  # unlinked while we scanned
             pass
     return out
+
+
+def sweep_orphan_arenas(shm_dir: str = "/dev/shm") -> list:
+    """Unlink every orphaned arena: a file no live process maps is
+    garbage by definition (the residue of a SIGKILL'd head/agent that
+    never ran its exit unlink), and each one pins its full size in
+    shared memory until someone reclaims it. A booting head calls this
+    — the natural janitor, since a hard-killed predecessor on the same
+    host is exactly what it replaces. Returns the swept
+    ``[(path, size_bytes)]``."""
+    import os
+
+    swept = []
+    for path, size in orphan_arena_files(shm_dir):
+        try:
+            os.unlink(path)
+            swept.append((path, size))
+        except OSError:  # raced another janitor
+            pass
+    return swept
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def _arena_growth_warnings(history: dict, cfg) -> list:
+    """Leak detection off the flight recorder (memory observatory),
+    factored pure so tests feed crafted history dicts: a node's
+    ``object_plane.arena_used_bytes`` series that never dipped across
+    the trailing ``arena_growth_warn_window_s`` AND grew by more than
+    ``arena_growth_warn_min_frac`` of capacity is the signature of a
+    reference leak — steady-state churn frees something eventually, so
+    its fill curve dips on every free."""
+    warns = []
+    series = (history or {}).get("series", {})
+    window = cfg.arena_growth_warn_window_s
+    if window <= 0:
+        return warns
+    for key, s in sorted(series.items()):
+        base = key.split("{", 1)[0]
+        if base != "object_plane.arena_used_bytes":
+            continue
+        pts = list(s.get("points") or [])
+        if pts:
+            newest = pts[-1][0]
+            pts = [p for p in pts if p[0] >= newest - window]
+        if len(pts) < 4 or pts[-1][0] - pts[0][0] < 0.5 * window:
+            continue  # not enough history to judge the window
+        vals = [p[1] for p in pts]
+        if any(b < a for a, b in zip(vals, vals[1:])):
+            continue  # dipped at least once: churn, not a leak
+        growth = vals[-1] - vals[0]
+        cap_pts = (series.get(key.replace(
+            "arena_used_bytes", "arena_capacity_bytes")) or {}) \
+            .get("points") or []
+        cap = cap_pts[-1][1] if cap_pts else 0.0
+        if growth <= 0 or (cap > 0 and
+                           growth < cfg.arena_growth_warn_min_frac * cap):
+            continue
+        where = key[key.find("{"):] if "{" in key else key
+        warns.append(
+            f"arena{where}: used bytes grew monotonically by "
+            f"{_fmt_bytes(growth)} over the last "
+            f"{pts[-1][0] - pts[0][0]:.0f}s without a single dip — "
+            "likely an object-reference leak (refs held in a growing "
+            "structure, or returns never freed); `ray_tpu memory "
+            "--group-by job` shows whose bytes are accumulating")
+    return warns
+
+
+def _memory_warnings(summary: dict, cfg) -> list:
+    """Point-in-time memory health off ``state.memory_summary()``,
+    factored pure for deterministic tests: near-highwater arena
+    pressure, resident objects whose owner worker is dead (orphan
+    refs — nothing will ever free them), and borrow-ledger deferred
+    deletes stuck past the TTL (a leaked zero-copy view holding arena
+    slots)."""
+    warns = []
+    for idx, row in sorted((summary or {}).get("nodes", {}).items(),
+                           key=lambda kv: str(kv[0])):
+        arena = row.get("arena") or {}
+        cap = arena.get("capacity", 0)
+        used = arena.get("used_bytes", 0)
+        if cap and used / cap > cfg.arena_pressure_warn_frac:
+            warns.append(
+                f"node {idx} arena at {used / cap:.0%} of capacity "
+                f"({_fmt_bytes(used)} / {_fmt_bytes(cap)}, > "
+                f"{cfg.arena_pressure_warn_frac:.0%}): the next "
+                "allocation burst will evict or fail — free objects, "
+                "raise object_store_bytes, or spill")
+        dd = arena.get("deferred_deletes", 0)
+        oldest = arena.get("deferred_delete_oldest_s", 0.0)
+        ttl = cfg.borrow_deferred_delete_warn_s
+        if dd and ttl > 0 and oldest > ttl:
+            warns.append(
+                f"node {idx}: {dd:.0f} deferred delete(s) stuck behind "
+                f"live zero-copy borrow views for {oldest:.0f}s (> "
+                f"{ttl:g}s): a leaked view (held array / dangling "
+                "reference) is pinning freed arena slots — the memory "
+                "is unreclaimable until the view dies")
+    do = (summary or {}).get("dead_owner") or {}
+    if do.get("bytes"):
+        owners = ", ".join(o[:8] for o in do.get("owners", [])[:5])
+        warns.append(
+            f"{do['objects']} resident object(s) "
+            f"({_fmt_bytes(do['bytes'])}) owned by dead worker(s) "
+            f"[{owners}]: orphan refs — their owners exited without "
+            "freeing them and nothing will; `ray_tpu memory --group-by "
+            "owner` lists them, free them or restart the job")
+    return warns
 
 
 def _serve_warnings(apps_status: dict, cfg) -> list:
@@ -464,6 +585,22 @@ def doctor_warnings() -> list:
                     "housekeeping may be wedged; remove the node "
                     "manually or restart the head")
     except Exception:  # noqa: BLE001 — no cluster up
+        pass
+    # memory observatory (r20): arena pressure / dead-owner orphans /
+    # deferred-delete pileup off the summary, monotone-growth leak
+    # detection off the flight recorder
+    try:
+        from ray_tpu.core.config import get_config as _gc
+
+        cfg = _gc()
+        summary = state.memory_summary()
+        if summary:
+            warns.extend(_memory_warnings(summary, cfg))
+        hist = state.metrics_history(
+            ["object_plane.arena_used_bytes",
+             "object_plane.arena_capacity_bytes"])
+        warns.extend(_arena_growth_warnings(hist, cfg))
+    except Exception:  # noqa: BLE001 — pre-r20 head / no cluster
         pass
     # serve autoscaler health (r14): reads the controller's status
     # introspection; no serve running (or no controller) warns nothing

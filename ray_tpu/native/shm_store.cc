@@ -74,6 +74,7 @@ struct Header {
   uint64_t num_objects;
   uint64_t free_head;  // offset of first free block (0 = none)
   uint64_t clock;      // monotone counter for create stamps
+  uint64_t highwater;  // max bytes_in_use ever observed (arena pressure)
   pthread_mutex_t mutex;
   ObjectEntry table[kTableSize];
 };
@@ -182,6 +183,7 @@ uint64_t arena_alloc(Store* s, uint64_t need) {
       }
       b->free_flag = 0;
       h->bytes_in_use += b->size;
+      if (h->bytes_in_use > h->highwater) h->highwater = h->bytes_in_use;
       return off + sizeof(BlockHeader);
     }
     off = b->next_free;
@@ -433,6 +435,54 @@ int shm_store_list(void* handle, uint8_t* out_ids, uint64_t* out_sizes,
     count++;
   }
   return count;
+}
+
+// One-pass arena accounting snapshot under a single lock acquisition
+// (the memory-observatory sampling path; cheap enough for a heartbeat
+// cadence — one 64k-entry table scan, no allocation). Writes 10 values:
+//   [capacity, bytes_in_use, highwater, num_objects,
+//    sealed_count, sealed_bytes, unsealed_count, unsealed_bytes,
+//    pinned_count, pinned_bytes]
+// sealed/unsealed bytes are PAYLOAD bytes (data + metadata) so they
+// compare exactly against the directory's per-object sizes; bytes_in_use
+// additionally carries block headers + alignment slack.
+void shm_store_memory_stats(void* handle, uint64_t* out) {
+  Store* s = static_cast<Store*>(handle);
+  Header* h = s->hdr;
+  Guard g(h);
+  uint64_t sealed_count = 0, sealed_bytes = 0, sealed_data_bytes = 0;
+  uint64_t unsealed_count = 0, unsealed_bytes = 0;
+  uint64_t pinned_count = 0, pinned_bytes = 0;
+  for (uint32_t i = 0; i < kTableSize; i++) {
+    ObjectEntry* e = &h->table[i];
+    if (e->state != kSealed && e->state != kCreated) continue;
+    uint64_t payload = e->data_size + e->meta_size;
+    if (e->state == kSealed) {
+      sealed_count++;
+      sealed_bytes += payload;
+      // data-only view: the wire size convention (directory entries,
+      // stripe ranges, pull buffers) excludes the frame-size metadata
+      sealed_data_bytes += e->data_size;
+    } else {
+      unsealed_count++;
+      unsealed_bytes += payload;
+    }
+    if (e->ref_count > 0) {
+      pinned_count++;
+      pinned_bytes += payload;
+    }
+  }
+  out[0] = h->arena_size;
+  out[1] = h->bytes_in_use;
+  out[2] = h->highwater;
+  out[3] = h->num_objects;
+  out[4] = sealed_count;
+  out[5] = sealed_bytes;
+  out[6] = unsealed_count;
+  out[7] = unsealed_bytes;
+  out[8] = pinned_count;
+  out[9] = pinned_bytes;
+  out[10] = sealed_data_bytes;
 }
 
 uint64_t shm_store_bytes_in_use(void* handle) {

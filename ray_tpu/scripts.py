@@ -59,6 +59,13 @@ def _start_head(args):
     import uuid
 
     from ray_tpu.core.head import Head
+    from ray_tpu.dashboard import sweep_orphan_arenas
+
+    # reclaim arenas a hard-killed predecessor (kill -9 head/agent)
+    # left pinned in /dev/shm — nobody maps them, so they're garbage
+    for path, size in sweep_orphan_arenas():
+        print(f"swept orphaned arena {path} ({size >> 20} MB)",
+              file=sys.stderr, flush=True)
 
     session_name = uuid.uuid4().hex[:10]
     session_dir = args.session_dir or \
@@ -342,7 +349,120 @@ def cmd_list(args):
     }[args.entity]
     _attached(args)
     rows = fn(limit=args.limit)
+    if getattr(args, "sort_by", None):
+        # descending for numeric keys (size, age_s) — the debugging
+        # question is "what's biggest/oldest", ascending for the rest
+        sample = next((r[args.sort_by] for r in rows
+                       if r.get(args.sort_by) is not None), 0)
+        numeric = isinstance(sample, (int, float))
+        rows.sort(key=lambda r: r.get(args.sort_by) or
+                  (0 if numeric else ""), reverse=numeric)
     print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
+_MEMORY_UNITS = {"b": 1, "kb": 1 << 10, "mb": 1 << 20, "gb": 1 << 30}
+
+
+def _fmt_mem(n, units: str) -> str:
+    if units != "auto":
+        div = _MEMORY_UNITS[units]
+        body = f"{n / div:,.2f}".rstrip("0").rstrip(".")
+        return body + units.upper()
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20),
+                      ("KiB", 1 << 10)):
+        if abs(n) >= div:
+            return f"{n / div:.2f}{unit}"
+    return f"{n:.0f}B"
+
+
+def cmd_memory(args):
+    """Cluster memory observatory (ref: `ray memory` /
+    memory_utils.py): resident bytes grouped by node / job / owner,
+    the reference-class breakdown, and the top-N largest objects with
+    age and holder set."""
+    from ray_tpu import state as state_api
+
+    _attached(args)
+    s = state_api.memory_summary()
+    if not s:
+        print("no memory summary available (pre-r20 head?)",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(s, indent=2, default=str))
+        return 0
+    u = args.units
+    tot = s.get("totals", {})
+    print(f"cluster resident: {_fmt_mem(tot.get('resident_bytes', 0), u)} "
+          f"in {tot.get('resident_objects', 0)} object(s); "
+          f"spilled: {_fmt_mem(tot.get('spilled_bytes', 0), u)}; "
+          f"prefetch in flight: {tot.get('prefetch_inflight', 0)}")
+    cls = s.get("classes", {})
+    print("by reference class:")
+    for label, key in (("sealed", "sealed_bytes"),
+                       ("borrow-pinned", "borrow_pinned_bytes"),
+                       ("checkpoint-held", "checkpoint_bytes"),
+                       ("prefetch-in-flight", "prefetch_inflight_bytes"),
+                       ("spilled", "spilled_bytes")):
+        print(f"  {label:<20} {_fmt_mem(cls.get(key, 0), u)}")
+    if args.group_by == "node":
+        print("by node:")
+        for idx, row in sorted(s.get("nodes", {}).items(),
+                               key=lambda kv: str(kv[0])):
+            arena = row.get("arena") or {}
+            cap = arena.get("capacity", 0)
+            used = arena.get("used_bytes", 0)
+            fill = f"  arena {_fmt_mem(used, u)}/{_fmt_mem(cap, u)} " \
+                   f"({used / cap:.0%}), highwater " \
+                   f"{_fmt_mem(arena.get('highwater_bytes', 0), u)}" \
+                if cap else "  (no arena heartbeat yet)"
+            print(f"  node {idx}: {_fmt_mem(row['resident_bytes'], u)} "
+                  f"in {row['resident_objects']} object(s)" + fill)
+    elif args.group_by == "job":
+        print("by job:")
+        for job, row in sorted(s.get("jobs", {}).items(),
+                               key=lambda kv: -kv[1]["resident_bytes"]):
+            per_node = ", ".join(
+                f"node {n}: {_fmt_mem(b, u)}" for n, b in
+                sorted(row.get("per_node", {}).items(),
+                       key=lambda kv: str(kv[0])))
+            print(f"  job {job or '(none)'}: "
+                  f"{_fmt_mem(row['resident_bytes'], u)} in "
+                  f"{row['objects']} object(s)"
+                  + (f"  [{per_node}]" if per_node else ""))
+    else:  # owner
+        print("by owner:")
+        for owner, row in sorted(s.get("owners", {}).items(),
+                                 key=lambda kv: -kv[1]["resident_bytes"]):
+            live = "" if row.get("live", True) else "  DEAD OWNER"
+            print(f"  {owner[:16] or '(none)':<16} "
+                  f"{_fmt_mem(row['resident_bytes'], u)} in "
+                  f"{row['objects']} object(s){live}")
+    objs = s.get("top_objects", [])[:args.top]
+    if args.sort_by == "age":
+        objs = sorted(objs, key=lambda o: -o.get("age_s", 0.0))
+    if objs:
+        print(f"top {len(objs)} objects (by {args.sort_by}):")
+        print(f"  {'object_id':<40} {'size':>10} {'age':>8} "
+              f"{'node':>4}  {'job':<8} {'owner':<8} {'class':<10} "
+              "holders")
+        for o in objs:
+            cls_label = o.get("tag") or \
+                ("spilled" if o.get("spilled") else "sealed")
+            print(f"  {o['object_id']:<40} "
+                  f"{_fmt_mem(o['size'], u):>10} "
+                  f"{o.get('age_s', 0.0):>7.1f}s "
+                  f"{o.get('node_idx', -1):>4}  "
+                  f"{(o.get('job') or '-')[:8]:<8} "
+                  f"{(o.get('owner') or '-')[:8]:<8} "
+                  f"{cls_label:<10} "
+                  f"{','.join(str(h) for h in o.get('holders', []))}")
+    dead = s.get("dead_owner") or {}
+    if dead.get("bytes"):
+        print(f"WARNING: {dead['objects']} object(s) "
+              f"({_fmt_mem(dead['bytes'], u)}) held by dead owner(s) "
+              f"{[o[:8] for o in dead.get('owners', [])]} — orphan refs")
     return 0
 
 
@@ -406,7 +526,29 @@ def build_parser() -> argparse.ArgumentParser:
                                        "cluster-events", "slow-tasks"])
     sp.add_argument("--address")
     sp.add_argument("--limit", type=int, default=100)
+    sp.add_argument("--sort-by", default=None,
+                    help="row key to sort by (descending for numeric "
+                         "keys — e.g. `list objects --sort-by size`)")
     sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser(
+        "memory",
+        help="cluster memory observatory: resident bytes by "
+             "node/job/owner, class breakdown, largest objects "
+             "(ref: `ray memory`)")
+    sp.add_argument("--group-by", choices=["node", "job", "owner"],
+                    default="node")
+    sp.add_argument("--sort-by", choices=["size", "age"], default="size",
+                    help="top-objects ordering")
+    sp.add_argument("--units", choices=["b", "kb", "mb", "gb", "auto"],
+                    default="auto")
+    sp.add_argument("--top", type=int, default=20,
+                    help="largest objects to show (head caps at "
+                         "memory_summary_top_n)")
+    sp.add_argument("--json", action="store_true",
+                    help="print the raw memory_summary() dict")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_memory)
 
     sp = sub.add_parser("summary", help="aggregate task/actor/object stats")
     sp.add_argument("entity", choices=["tasks", "actors", "objects"])

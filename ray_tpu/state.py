@@ -114,6 +114,25 @@ def object_plane_stats() -> Dict[str, Any]:
     return rows[0] if rows else {}
 
 
+def memory_summary(top_n: int = 0) -> Dict[str, Any]:
+    """Cluster memory rollup (memory observatory; ref parity: `ray
+    memory` / memory_utils.py's grouped object table + the dashboard
+    memory view). Returns ``{nodes, jobs, owners, classes, dead_owner,
+    top_objects, totals}``: per-node resident/spilled bytes merged with
+    each node's last ``object_plane.arena_*`` heartbeat (store
+    memory_stats()), per-job and per-owner resident-byte aggregates,
+    the reference-class breakdown (sealed / spilled / checkpoint-held /
+    prefetch-in-flight / borrow-pinned), resident bytes whose owner
+    worker is dead (orphan refs), and the top-N largest objects with
+    age and holder set. ``top_n`` > 0 caps the object list client-side
+    (the head already caps at ``memory_summary_top_n``)."""
+    rows = _query("memory_summary", 1 << 20)
+    out = rows[0] if rows else {}
+    if top_n > 0 and out.get("top_objects"):
+        out["top_objects"] = out["top_objects"][:top_n]
+    return out
+
+
 def io_loop_stats() -> List[Dict[str, Any]]:
     """Head event-loop health (analog: the reference's
     instrumented_io_context / event_stats.h per-handler timing):

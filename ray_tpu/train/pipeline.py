@@ -961,6 +961,13 @@ class Pipeline:
             t.join(timeout=120)
         if not all(secured):
             return False
+        # memory observatory: mark the held generation "checkpoint" so
+        # `ray_tpu memory`'s class breakdown separates checkpoint-held
+        # bytes from ordinary sealed objects (advisory, one-way)
+        try:
+            ctx.tag_objects(refs, "checkpoint")
+        except Exception:  # noqa: BLE001 — accounting must not fail a ckpt
+            pass
         self._ckpt = dict(enumerate(refs))
         self._ckpt_wave = wave_idx
         return True

@@ -61,6 +61,37 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _no_orphan_arenas():
+    """Arena-hygiene invariant (memory observatory): the suite FAILS if
+    it leaves orphaned ``/dev/shm/rtpu_*`` arenas behind — files no live
+    process maps, each pinning its full arena size in shared memory
+    until someone unlinks them (an r18 session leaked ~126 GB this
+    way). r19 added unlink-on-exit; this fixture turns it from a doctor
+    hint into an enforced CI invariant. Pre-existing orphans (other
+    sessions on a shared host) are snapshotted and excluded — only
+    arenas THIS suite leaked fail it."""
+    from ray_tpu.dashboard import orphan_arena_files
+
+    before = {p for p, _ in orphan_arena_files()}
+    yield
+    leaked = [x for x in orphan_arena_files() if x[0] not in before]
+    if leaked:
+        # agent/worker teardown is asynchronous: give late atexit
+        # unlinkers one grace window before declaring the leak
+        import time as _t
+
+        _t.sleep(2.0)
+        leaked = [x for x in orphan_arena_files() if x[0] not in before]
+    if leaked:
+        total_mb = sum(sz for _, sz in leaked) / (1024 * 1024)
+        names = ", ".join(p for p, _ in leaked[:8])
+        raise RuntimeError(
+            f"test session leaked {len(leaked)} orphaned shm arena(s) "
+            f"pinning {total_mb:.0f} MB: {names} — a store was created "
+            "without being destroyed/unlinked on teardown")
+
+
 @pytest.fixture
 def ray_start():
     """Fresh single-node runtime per test (4 CPUs)."""

@@ -738,3 +738,9 @@ def test_head_crash_restart_cluster_survives(tmp_path):
             _stop_proc(a)
         _stop_proc(head)
         _stop_proc(head2)
+        # the final head died by SIGKILL with no successor to boot (a
+        # booting head sweeps its predecessor's arena) — reclaim its
+        # orphaned arena here or the suite-wide hygiene fixture fails
+        from ray_tpu.dashboard import sweep_orphan_arenas
+
+        sweep_orphan_arenas()
